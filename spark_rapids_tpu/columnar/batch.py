@@ -1,0 +1,276 @@
+"""Device-resident columnar batches as JAX pytrees.
+
+This is the TPU re-design of the reference's columnar runtime
+(sql-plugin GpuColumnVector.java / ColumnarBatch over cuDF device columns).
+cuDF allocates exact-size device buffers per kernel result; XLA instead wants
+static shapes, so a batch here is a *fixed-capacity* set of device arrays plus
+a runtime ``num_rows`` scalar — rows past ``num_rows`` are padding. Capacities
+come from a power-of-two bucket ladder so the number of distinct compiled
+programs stays bounded (SURVEY.md §7 "hard parts" #1).
+
+Layout per column:
+- fixed-width type T: ``data (capacity,) T`` + ``validity (capacity,) bool``
+- string: ``data (capacity, width) uint8`` (zero-padded) +
+  ``lengths (capacity,) int32`` + validity. Fixed-width padded bytes are the
+  TPU-first answer to cuDF's offsets+chars: every string op becomes a dense
+  (N, W) vector op on the VPU instead of a gather over a ragged buffer.
+
+Null semantics: ``validity[i]`` True means non-null. Padding rows have
+validity False and zeroed data so results stay deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+
+MIN_CAPACITY = 8
+
+
+def bucket_capacity(n: int) -> int:
+    """Round row count up to the capacity bucket ladder (powers of two)."""
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One column of a device batch. A pytree: arrays are leaves, dtype is aux."""
+
+    dtype: DataType
+    data: jax.Array            # (capacity,) or (capacity, width) uint8 for strings
+    validity: jax.Array        # (capacity,) bool, True = non-null
+    lengths: Optional[jax.Array] = None   # (capacity,) int32, strings only
+
+    # -- pytree protocol -----------------------------------------------------
+    def tree_flatten(self):
+        if self.dtype.is_string:
+            return (self.data, self.validity, self.lengths), self.dtype
+        return (self.data, self.validity), self.dtype
+
+    @classmethod
+    def tree_unflatten(cls, dtype, leaves):
+        if dtype.is_string:
+            data, validity, lengths = leaves
+            return cls(dtype, data, validity, lengths)
+        data, validity = leaves
+        return cls(dtype, data, validity)
+
+    # -- shape info ----------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def string_width(self) -> int:
+        assert self.dtype.is_string
+        return self.data.shape[1]
+
+    # -- construction --------------------------------------------------------
+    @classmethod
+    def full_null(cls, dtype: DataType, capacity: int,
+                  string_width: int = 8) -> "DeviceColumn":
+        if dtype.is_string:
+            return cls(dtype,
+                       jnp.zeros((capacity, string_width), jnp.uint8),
+                       jnp.zeros((capacity,), jnp.bool_),
+                       jnp.zeros((capacity,), jnp.int32))
+        return cls(dtype,
+                   jnp.zeros((capacity,), dtype.np_dtype),
+                   jnp.zeros((capacity,), jnp.bool_))
+
+    # -- row movement primitives --------------------------------------------
+    def gather(self, indices: jax.Array, valid_dst: jax.Array) -> "DeviceColumn":
+        """Take rows at ``indices``; ``valid_dst`` masks live destination rows."""
+        data = jnp.take(self.data, indices, axis=0, mode="clip")
+        validity = jnp.take(self.validity, indices, axis=0, mode="clip") & valid_dst
+        data = _zero_dead(data, validity)
+        if self.dtype.is_string:
+            lengths = jnp.take(self.lengths, indices, axis=0, mode="clip")
+            lengths = jnp.where(validity, lengths, 0)
+            return DeviceColumn(self.dtype, data, validity, lengths)
+        return DeviceColumn(self.dtype, data, validity)
+
+    def scatter(self, positions: jax.Array, capacity: int) -> "DeviceColumn":
+        """Write row i to ``positions[i]``; positions >= capacity are dropped."""
+        if self.dtype.is_string:
+            shape = (capacity, self.string_width)
+        else:
+            shape = (capacity,)
+        data = jnp.zeros(shape, self.data.dtype).at[positions].set(
+            self.data, mode="drop")
+        validity = jnp.zeros((capacity,), jnp.bool_).at[positions].set(
+            self.validity, mode="drop")
+        if self.dtype.is_string:
+            lengths = jnp.zeros((capacity,), jnp.int32).at[positions].set(
+                self.lengths, mode="drop")
+            return DeviceColumn(self.dtype, data, validity, lengths)
+        return DeviceColumn(self.dtype, data, validity)
+
+    def with_validity(self, validity: jax.Array) -> "DeviceColumn":
+        data = _zero_dead(self.data, validity)
+        if self.dtype.is_string:
+            return DeviceColumn(self.dtype, data, validity,
+                                jnp.where(validity, self.lengths, 0))
+        return DeviceColumn(self.dtype, data, validity)
+
+
+def _zero_dead(data: jax.Array, validity: jax.Array) -> jax.Array:
+    """Zero data where validity is False (keeps padding deterministic)."""
+    if data.ndim == 2:
+        return jnp.where(validity[:, None], data, jnp.zeros_like(data))
+    return jnp.where(validity, data, jnp.zeros_like(data))
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceBatch:
+    """A fixed-capacity columnar batch in HBM: the unit all operators consume.
+
+    ``num_rows`` is a device int32 scalar so that data-dependent row counts
+    (filter/join/groupby outputs) never force a recompile; ``capacity`` is
+    static. Mirrors the role of the reference's ColumnarBatch of
+    GpuColumnVectors (GpuColumnVector.java:from(Table)).
+    """
+
+    columns: Tuple[DeviceColumn, ...]
+    num_rows: jax.Array          # int32 scalar
+
+    def tree_flatten(self):
+        return (tuple(self.columns), self.num_rows), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, leaves):
+        columns, num_rows = leaves
+        return cls(tuple(columns), num_rows)
+
+    @property
+    def capacity(self) -> int:
+        return self.columns[0].capacity if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def row_mask(self) -> jax.Array:
+        """(capacity,) bool — True for live (non-padding) rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+
+    # -- row movement --------------------------------------------------------
+    def gather(self, indices: jax.Array, new_num_rows: jax.Array) -> "DeviceBatch":
+        cap = indices.shape[0]
+        valid_dst = jnp.arange(cap, dtype=jnp.int32) < new_num_rows
+        cols = tuple(c.gather(indices, valid_dst) for c in self.columns)
+        return DeviceBatch(cols, jnp.asarray(new_num_rows, jnp.int32))
+
+    def compact(self, keep: jax.Array) -> "DeviceBatch":
+        """Keep rows where ``keep`` (already ANDed with row_mask) — stable.
+
+        The engine's row-compaction primitive (cuDF ``Table.filter`` analog):
+        positions = exclusive cumsum of keep; scatter-with-drop packs kept
+        rows to the front. O(n), single pass, XLA-fusable.
+        """
+        keep = keep & self.row_mask()
+        positions = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        positions = jnp.where(keep, positions, self.capacity)  # dropped
+        new_rows = jnp.sum(keep.astype(jnp.int32))
+        cols = tuple(c.scatter(positions, self.capacity) for c in self.columns)
+        return DeviceBatch(cols, new_rows)
+
+    def head(self, n: jax.Array) -> "DeviceBatch":
+        """First min(n, num_rows) rows (GpuLocalLimit analog)."""
+        new_rows = jnp.minimum(jnp.asarray(n, jnp.int32), self.num_rows)
+        mask = jnp.arange(self.capacity, dtype=jnp.int32) < new_rows
+        cols = tuple(c.with_validity(c.validity & mask) for c in self.columns)
+        return DeviceBatch(cols, new_rows)
+
+    def select(self, indices: Sequence[int]) -> "DeviceBatch":
+        return DeviceBatch(tuple(self.columns[i] for i in indices), self.num_rows)
+
+    @property
+    def dtypes(self) -> Tuple[DataType, ...]:
+        return tuple(c.dtype for c in self.columns)
+
+    def device_size_bytes(self) -> int:
+        """Approximate HBM footprint (for the spill framework's accounting)."""
+        total = 4
+        for c in self.columns:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.validity.size  # bool = 1 byte
+            if c.lengths is not None:
+                total += c.lengths.size * 4
+        return total
+
+
+def concat_batches(batches: Sequence[DeviceBatch], capacity: int) -> DeviceBatch:
+    """Concatenate batches into one of ``capacity`` rows.
+
+    The cuDF ``Table.concatenate`` analog used by GpuCoalesceBatches
+    (GpuCoalesceBatches.scala:643). Capacities are static, so overflow is
+    checked at trace time: sum of member capacities must fit.
+    Strings are re-padded to the widest member width.
+    """
+    assert batches, "concat of zero batches"
+    total_cap = sum(b.capacity for b in batches)
+    assert total_cap <= capacity, (
+        f"concat overflow: member capacities sum to {total_cap} > {capacity}")
+    ncols = batches[0].num_columns
+    out_cols: List[DeviceColumn] = []
+    total_rows = sum((b.num_rows for b in batches),
+                     start=jnp.asarray(0, jnp.int32))
+    # Destination offset of each batch = cumsum of preceding num_rows.
+    offsets = []
+    acc = jnp.asarray(0, jnp.int32)
+    for b in batches:
+        offsets.append(acc)
+        acc = acc + b.num_rows
+    for ci in range(ncols):
+        members = [b.columns[ci] for b in batches]
+        dtype = members[0].dtype
+        if dtype.is_string:
+            width = max(m.string_width for m in members)
+            members = [string_repad(m, width) for m in members]
+        # Fold all members into one accumulator with chained disjoint
+        # scatters — each destination element is written once.
+        shape = ((capacity, members[0].string_width) if dtype.is_string
+                 else (capacity,))
+        data = jnp.zeros(shape, members[0].data.dtype)
+        validity = jnp.zeros((capacity,), jnp.bool_)
+        lengths = jnp.zeros((capacity,), jnp.int32) if dtype.is_string else None
+        for b, m, off in zip(batches, members, offsets):
+            live = m.validity & b.row_mask()
+            pos = jnp.where(b.row_mask(),
+                            jnp.arange(b.capacity, dtype=jnp.int32) + off,
+                            capacity)
+            data = data.at[pos].set(_zero_dead(m.data, live), mode="drop")
+            validity = validity.at[pos].set(live, mode="drop")
+            if dtype.is_string:
+                lengths = lengths.at[pos].set(
+                    jnp.where(live, m.lengths, 0), mode="drop")
+        out_cols.append(DeviceColumn(dtype, data, validity, lengths))
+    return DeviceBatch(tuple(out_cols), total_rows)
+
+
+def string_repad(col: DeviceColumn, width: int) -> DeviceColumn:
+    """Re-pad a string column's byte matrix to ``width`` (static)."""
+    assert col.dtype.is_string
+    cur = col.string_width
+    if cur == width:
+        return col
+    if cur < width:
+        pad = jnp.zeros((col.capacity, width - cur), jnp.uint8)
+        return DeviceColumn(col.dtype, jnp.concatenate([col.data, pad], axis=1),
+                            col.validity, col.lengths)
+    # Narrowing: only legal when all lengths fit — caller's responsibility
+    # (used by ops like substring that provably shrink strings). Lengths are
+    # clamped so the column stays internally consistent either way.
+    return DeviceColumn(col.dtype, col.data[:, :width], col.validity,
+                        jnp.minimum(col.lengths, width))

@@ -1,0 +1,173 @@
+"""Host-resident columnar batches and host<->device transitions.
+
+The analog of the reference's RapidsHostColumnVector + GpuRowToColumnarExec /
+GpuColumnarToRowExec / HostColumnarToGpu trio (SURVEY.md §2.3): host data is
+numpy (fixed width) or numpy object arrays of bytes (strings); transitions
+pad to the capacity bucket and upload, or download and trim to num_rows.
+
+Host batches are also the currency of the CPU-fallback engine
+(plan/physical.py) — the numpy analog of rows staying on CPU Spark.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity)
+
+
+@dataclasses.dataclass
+class HostColumn:
+    """One host column: values + validity. Strings are ``object`` arrays of
+    python ``bytes`` (None entries are allowed and mean null)."""
+
+    dtype: DataType
+    data: np.ndarray               # (n,) typed, or (n,) object of bytes
+    validity: np.ndarray           # (n,) bool
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.data)
+
+    @classmethod
+    def from_values(cls, dtype: DataType, values: Sequence) -> "HostColumn":
+        """Build from a python sequence; None means null."""
+        n = len(values)
+        validity = np.array([v is not None for v in values], dtype=np.bool_)
+        if dtype.is_string:
+            data = np.empty(n, dtype=object)
+            for i, v in enumerate(values):
+                if v is None:
+                    data[i] = b""
+                else:
+                    data[i] = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+        else:
+            data = np.zeros(n, dtype=dtype.np_dtype)
+            idx = np.nonzero(validity)[0]
+            if len(idx):
+                data[idx] = np.asarray([values[i] for i in idx],
+                                       dtype=dtype.np_dtype)
+        return cls(dtype, data, validity)
+
+    def to_list(self) -> list:
+        """Python values with None for nulls (test/compare currency)."""
+        out = []
+        for i in range(self.num_rows):
+            if not self.validity[i]:
+                out.append(None)
+            elif self.dtype.is_string:
+                out.append(bytes(self.data[i]).decode("utf-8", "replace"))
+            elif self.dtype.is_boolean:
+                out.append(bool(self.data[i]))
+            elif self.dtype.is_floating:
+                out.append(float(self.data[i]))
+            else:
+                out.append(int(self.data[i]))
+        return out
+
+
+@dataclasses.dataclass
+class HostBatch:
+    names: Tuple[str, ...]
+    columns: List[HostColumn]
+
+    @property
+    def num_rows(self) -> int:
+        return self.columns[0].num_rows if self.columns else 0
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, name: str) -> HostColumn:
+        return self.columns[self.names.index(name)]
+
+    def to_pylist(self) -> List[tuple]:
+        cols = [c.to_list() for c in self.columns]
+        return list(zip(*cols)) if cols else []
+
+    @classmethod
+    def from_pydict(cls, schema: Sequence[Tuple[str, DataType]],
+                    data: dict) -> "HostBatch":
+        names = tuple(n for n, _ in schema)
+        cols = [HostColumn.from_values(t, data[n]) for n, t in schema]
+        return cls(names, cols)
+
+
+# ---------------------------------------------------------------------------
+# Transitions (host -> device -> host)
+# ---------------------------------------------------------------------------
+
+def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
+                   string_widths: Optional[dict] = None) -> DeviceBatch:
+    """Upload a host batch into a fresh fixed-capacity device batch.
+
+    Ref: HostColumnarToGpu.scala / GpuRowToColumnarExec.scala — here the
+    "builders" are numpy padding + one jnp.asarray per buffer so the upload
+    is a handful of contiguous H2D copies.
+    """
+    n = batch.num_rows
+    cap = capacity if capacity is not None else bucket_capacity(n)
+    assert cap >= n, f"capacity {cap} < rows {n}"
+    cols = []
+    for name, hc in zip(batch.names, batch.columns):
+        validity = np.zeros(cap, dtype=np.bool_)
+        validity[:n] = hc.validity
+        if hc.dtype.is_string:
+            max_len = 0
+            for i in range(n):
+                if hc.validity[i]:
+                    max_len = max(max_len, len(hc.data[i]))
+            want = dt.string_width_bucket(max_len)
+            if string_widths and name in string_widths:
+                want = max(want, string_widths[name])
+            data = np.zeros((cap, want), dtype=np.uint8)
+            lengths = np.zeros(cap, dtype=np.int32)
+            for i in range(n):
+                if hc.validity[i]:
+                    b = hc.data[i]
+                    data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+                    lengths[i] = len(b)
+            cols.append(DeviceColumn(hc.dtype, jnp.asarray(data),
+                                     jnp.asarray(validity),
+                                     jnp.asarray(lengths)))
+        else:
+            data = np.zeros(cap, dtype=hc.dtype.np_dtype)
+            data[:n] = np.where(hc.validity, hc.data,
+                                np.zeros(1, hc.dtype.np_dtype))
+            cols.append(DeviceColumn(hc.dtype, jnp.asarray(data),
+                                     jnp.asarray(validity)))
+    return DeviceBatch(tuple(cols), jnp.asarray(n, jnp.int32))
+
+
+def device_to_host(batch: DeviceBatch,
+                   names: Optional[Sequence[str]] = None) -> HostBatch:
+    """Download a device batch, trimming padding rows.
+
+    Ref: GpuColumnarToRowExec.scala — the single place results leave HBM.
+    """
+    n = int(batch.num_rows)
+    cols = []
+    for c in batch.columns:
+        validity = np.asarray(c.validity)[:n]
+        if c.dtype.is_string:
+            data_m = np.asarray(c.data)[:n]
+            lengths = np.asarray(c.lengths)[:n]
+            data = np.empty(n, dtype=object)
+            for i in range(n):
+                data[i] = data_m[i, :lengths[i]].tobytes() if validity[i] else b""
+            cols.append(HostColumn(c.dtype, data, validity))
+        else:
+            data = np.asarray(c.data)[:n].copy()
+            data[~validity] = np.zeros(1, c.dtype.np_dtype)
+            cols.append(HostColumn(c.dtype, data, validity))
+    if names is None:
+        names = tuple(f"c{i}" for i in range(batch.num_columns))
+    return HostBatch(tuple(names), cols)

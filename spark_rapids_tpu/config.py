@@ -1,0 +1,308 @@
+"""Typed, self-documenting configuration registry.
+
+The analog of the reference's RapidsConf.scala (1049 LoC builder DSL producing
+typed ConfEntry objects, a registry, and generated docs/configs.md). Same
+design: ``conf("spark.rapids...").doc(...).boolean(default)`` builders append
+to a module-level registry; ``TpuConf`` resolves values from a plain dict (the
+stand-in for Spark SQL conf); ``generate_docs()`` renders the markdown table.
+
+Per-operator kill-switch keys (``spark.rapids.sql.exec.*`` /
+``spark.rapids.sql.expression.*``) are registered dynamically by the
+plan-rewrite rules (plan/overrides.py), mirroring RapidsMeta's ``confKey``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    doc: str
+    value_type: str            # "boolean" | "integer" | "long" | "double" | "string"
+    default: Any
+    converter: Callable[[str], Any]
+    internal: bool = False
+
+    def get(self, conf: "TpuConf") -> Any:
+        raw = conf.raw.get(self.key)
+        if raw is None:
+            return self.default
+        if isinstance(raw, str):
+            return self.converter(raw)
+        # Coerce non-string values to the declared type so typed accessors
+        # never leak e.g. int 0 where a bool is expected.
+        if self.value_type == "boolean":
+            if not isinstance(raw, bool):
+                raise ValueError(
+                    f"{self.key} expects a boolean, got {raw!r}")
+            return raw
+        if self.value_type in ("integer", "long"):
+            return int(raw)
+        if self.value_type == "double":
+            return float(raw)
+        return raw
+
+
+_REGISTRY: Dict[str, ConfEntry] = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def _parse_bool(s: str) -> bool:
+    v = s.strip().lower()
+    if v in ("true", "1", "yes"):
+        return True
+    if v in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean config value: {s!r}")
+
+
+class _Builder:
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._internal = False
+
+    def doc(self, text: str) -> "_Builder":
+        self._doc = text
+        return self
+
+    def internal(self) -> "_Builder":
+        self._internal = True
+        return self
+
+    def _register(self, value_type, default, converter) -> ConfEntry:
+        entry = ConfEntry(self._key, self._doc, value_type, default, converter,
+                          self._internal)
+        with _REGISTRY_LOCK:
+            if self._key in _REGISTRY:
+                return _REGISTRY[self._key]   # idempotent re-registration
+            _REGISTRY[self._key] = entry
+        return entry
+
+    def boolean(self, default: bool) -> ConfEntry:
+        return self._register("boolean", default, _parse_bool)
+
+    def integer(self, default: int) -> ConfEntry:
+        return self._register("integer", default, int)
+
+    def long(self, default: int) -> ConfEntry:
+        return self._register("long", default, int)
+
+    def double(self, default: float) -> ConfEntry:
+        return self._register("double", default, float)
+
+    def string(self, default: Optional[str]) -> ConfEntry:
+        return self._register("string", default, str)
+
+
+def conf(key: str) -> _Builder:
+    return _Builder(key)
+
+
+def registered_entries() -> List[ConfEntry]:
+    with _REGISTRY_LOCK:
+        return sorted(_REGISTRY.values(), key=lambda e: e.key)
+
+
+# ---------------------------------------------------------------------------
+# Core entries (ref: RapidsConf.scala:282-751; keys kept compatible where the
+# concept carries over, with TPU-specific replacements where it does not).
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf("spark.rapids.sql.enabled").doc(
+    "Enable or disable running SQL operators on the TPU.").boolean(True)
+
+DEVICE = conf("spark.rapids.device").doc(
+    "Accelerator backend to target: 'tpu' (jax default backend) or 'cpu' "
+    "(host fallback everywhere; useful for debugging).").string("tpu")
+
+EXPLAIN = conf("spark.rapids.sql.explain").doc(
+    "Explain why parts of a query were or were not placed on the TPU: "
+    "NONE, ALL, or NOT_ON_GPU (only print replacement failures).").string("NONE")
+
+BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
+    "Target size in bytes for coalesced TPU batches. Larger batches amortize "
+    "kernel launch/compile overhead; bounded by HBM.").long(512 * 1024 * 1024)
+
+BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
+    "Target row capacity bucket for coalesced TPU batches (power of two). "
+    "TPU addition: row capacity, not just bytes, is what bounds XLA "
+    "recompilation.").long(1 << 20)
+
+CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentTpuTasks").doc(
+    "Number of tasks that may issue work to one TPU chip concurrently "
+    "(ref: spark.rapids.sql.concurrentGpuTasks / GpuSemaphore).").integer(2)
+
+INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
+    "Enable operators that produce results that differ from Spark CPU in "
+    "corner cases (float aggregation order, locale-sensitive strings...)."
+).boolean(False)
+
+HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
+    "Assume floating point data may contain NaNs; disables some fast paths "
+    "when true.").boolean(True)
+
+VARIABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
+    "Allow float/double aggregations whose result can vary with evaluation "
+    "order (parallel tree reductions on TPU).").boolean(False)
+
+CAST_FLOAT_TO_STRING = conf(
+    "spark.rapids.sql.castFloatToString.enabled").doc(
+    "Allow float->string casts that may format differently from Spark."
+).boolean(False)
+
+CAST_STRING_TO_FLOAT = conf(
+    "spark.rapids.sql.castStringToFloat.enabled").doc(
+    "Allow string->float casts that may differ in corner cases."
+).boolean(False)
+
+IMPROVED_FLOAT_OPS = conf("spark.rapids.sql.improvedFloatOps.enabled").doc(
+    "Use TPU-fused float paths that can round differently from the JVM."
+).boolean(False)
+
+TEST_ENABLED = conf("spark.rapids.sql.test.enabled").doc(
+    "Test mode: fail any query that executes a non-allowlisted operator on "
+    "the host (ref: GpuTransitionOverrides.assertIsOnTheGpu).").boolean(False)
+
+TEST_ALLOWED_NONTPU = conf("spark.rapids.sql.test.allowedNonTpu").doc(
+    "Comma-separated exec class names tolerated on host in test mode."
+).string("")
+
+MAX_READER_BATCH_SIZE_ROWS = conf(
+    "spark.rapids.sql.reader.batchSizeRows").doc(
+    "Soft cap on rows per batch produced by file readers.").long(1 << 20)
+
+MAX_READER_BATCH_SIZE_BYTES = conf(
+    "spark.rapids.sql.reader.batchSizeBytes").doc(
+    "Soft cap on bytes per batch produced by file readers."
+).long(512 * 1024 * 1024)
+
+PARQUET_READER_TYPE = conf("spark.rapids.sql.format.parquet.reader.type").doc(
+    "Parquet reader strategy: PERFILE, COALESCING, MULTITHREADED, or AUTO "
+    "(ref: GpuParquetScan.scala reader selection).").string("AUTO")
+
+PARQUET_MULTITHREADED_READ_NUM_THREADS = conf(
+    "spark.rapids.sql.format.parquet.multiThreadedRead.numThreads").doc(
+    "Host threads used to read parquet row groups in parallel.").integer(20)
+
+ENABLE_PARQUET = conf("spark.rapids.sql.format.parquet.enabled").doc(
+    "Enable parquet scan/write on TPU path.").boolean(True)
+
+ENABLE_CSV = conf("spark.rapids.sql.format.csv.enabled").doc(
+    "Enable CSV scan on TPU path.").boolean(True)
+
+ENABLE_ORC = conf("spark.rapids.sql.format.orc.enabled").doc(
+    "Enable ORC scan/write on TPU path.").boolean(True)
+
+REPLACE_SORT_MERGE_JOIN = conf(
+    "spark.rapids.sql.replaceSortMergeJoin.enabled").doc(
+    "Replace sort-merge joins with TPU hash joins, dropping the sorts "
+    "(ref: GpuSortMergeJoinExec meta).").boolean(True)
+
+STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").doc(
+    "Use stable sorting (matches Spark's sort for ties at a small cost)."
+).boolean(True)
+
+SHUFFLE_COMPRESSION_CODEC = conf(
+    "spark.rapids.shuffle.compression.codec").doc(
+    "Codec for shuffle partition payloads: none or copy (testing). "
+    "(ref: nvcomp LZ4; TPU path keeps data in HBM so codec is host-side "
+    "only when spilled.)").string("none")
+
+SHUFFLE_PARTITIONS = conf("spark.rapids.sql.shuffle.partitions").doc(
+    "Number of shuffle output partitions for exchanges (analog of "
+    "spark.sql.shuffle.partitions).").integer(8)
+
+HBM_POOL_FRACTION = conf("spark.rapids.memory.tpu.allocFraction").doc(
+    "Fraction of visible HBM the engine budgets for batch storage; the "
+    "watermark evictor starts spilling above it (ref: RMM pool + "
+    "DeviceMemoryEventHandler).").double(0.9)
+
+HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
+    "Bytes of host RAM for spilled device batches before going to disk."
+).long(1024 * 1024 * 1024)
+
+SPILL_DIR = conf("spark.rapids.memory.spill.dir").doc(
+    "Directory for the disk spill tier.").string("/tmp/spark_rapids_tpu_spill")
+
+UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
+    "Trace python UDFs with JAX into columnar expressions when possible "
+    "(the TPU-native analog of the bytecode->Catalyst udf-compiler)."
+).boolean(True)
+
+METRICS_ENABLED = conf("spark.rapids.sql.metrics.enabled").doc(
+    "Collect per-operator metrics (rows/batches/time).").boolean(True)
+
+
+class TpuConf:
+    """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None):
+        self.raw = dict(raw or {})
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self)
+
+    def get_key(self, key: str, default: Any = None) -> Any:
+        entry = _REGISTRY.get(key)
+        if entry is not None:
+            return entry.get(self)
+        return self.raw.get(key, default)
+
+    def set(self, key: str, value: Any) -> "TpuConf":
+        self.raw[key] = value
+        return self
+
+    def is_op_enabled(self, conf_key: str) -> bool:
+        """Per-rule kill switch lookup; default True (ref: RapidsMeta confKey)."""
+        raw = self.raw.get(conf_key)
+        if raw is None:
+            return True
+        return raw if isinstance(raw, bool) else _parse_bool(str(raw))
+
+    # Convenience accessors used widely.
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def batch_size_bytes(self) -> int:
+        return self.get(BATCH_SIZE_BYTES)
+
+    @property
+    def incompatible_ops(self) -> bool:
+        return self.get(INCOMPATIBLE_OPS)
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+
+def generate_docs() -> str:
+    """Render configs.md, same shape as the reference's generated docs."""
+    lines = [
+        "# spark-rapids-tpu Configuration",
+        "",
+        "Generated from spark_rapids_tpu.config — do not edit by hand.",
+        "",
+        "| Name | Description | Default |",
+        "|---|---|---|",
+    ]
+    for e in registered_entries():
+        if e.internal:
+            continue
+        default = "None" if e.default is None else str(e.default)
+        lines.append(f"| {e.key} | {e.doc} | {default} |")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Multi-chip hardware is not available in CI; all sharding logic is exercised on
+``--xla_force_host_platform_device_count=8`` CPU devices (SURVEY.md §4's
+"distributed without a cluster" strategy, re-imagined for JAX). Must run
+before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# The environment's sitecustomize registers a remote-TPU ("axon") PJRT plugin
+# and points jax_platforms at it; initializing it costs a slow tunnel claim.
+# Tests must be hermetic and CPU-only, so drop the plugin before any backend
+# is materialized and pin the platform list back to cpu.
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

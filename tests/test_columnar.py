@@ -1,0 +1,107 @@
+"""Columnar runtime round-trip tests (ref strategy: SURVEY.md §4 tier 1,
+RapidsDeviceMemoryStoreSuite-style pure-unit tests, no cluster)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.columnar.batch import (
+    bucket_capacity, concat_batches)
+from spark_rapids_tpu.columnar.host import (
+    HostBatch, HostColumn, device_to_host, host_to_device)
+
+
+def make_host(schema, data):
+    return HostBatch.from_pydict(schema, data)
+
+
+def test_bucket_capacity():
+    assert bucket_capacity(0) == 8
+    assert bucket_capacity(8) == 8
+    assert bucket_capacity(9) == 16
+    assert bucket_capacity(1000) == 1024
+
+
+@pytest.mark.parametrize("dtype,values", [
+    (srt.INT32, [1, None, 3, -7]),
+    (srt.INT64, [2**40, None, -1, 0]),
+    (srt.FLOAT64, [1.5, float("nan"), None, -0.0]),
+    (srt.FLOAT32, [1.25, None, 3.5, 0.0]),
+    (srt.BOOL, [True, False, None, True]),
+    (srt.INT8, [1, -128, 127, None]),
+    (srt.DATE, [0, 18628, None, -365]),
+    (srt.TIMESTAMP, [0, 1_600_000_000_000_000, None, -1]),
+    (srt.STRING, ["hello", "", None, "wörld"]),
+])
+def test_round_trip(dtype, values):
+    hb = make_host([("c", dtype)], {"c": values})
+    db = host_to_device(hb)
+    assert db.capacity == bucket_capacity(len(values))
+    back = device_to_host(db, names=("c",))
+    got = back.columns[0].to_list()
+    for g, v in zip(got, values):
+        if v is None:
+            assert g is None
+        elif isinstance(v, float) and v != v:
+            assert g != g  # NaN
+        else:
+            assert g == v
+
+
+def test_compact_filter():
+    hb = make_host([("a", srt.INT32), ("s", srt.STRING)],
+                   {"a": [1, 2, None, 4, 5], "s": ["x", "yy", "zzz", None, "v"]})
+    db = host_to_device(hb)
+    keep = jnp.asarray([True, False, True, True, False, True, True, True])
+    out = db.compact(keep)
+    assert int(out.num_rows) == 3
+    back = device_to_host(out, names=("a", "s"))
+    assert back.columns[0].to_list() == [1, None, 4]
+    assert back.columns[1].to_list() == ["x", "zzz", None]
+
+
+def test_head_limit():
+    hb = make_host([("a", srt.INT64)], {"a": list(range(6))})
+    db = host_to_device(hb)
+    out = db.head(4)
+    assert int(out.num_rows) == 4
+    assert device_to_host(out).columns[0].to_list() == [0, 1, 2, 3]
+    out2 = db.head(100)
+    assert int(out2.num_rows) == 6
+
+
+def test_concat_batches():
+    h1 = make_host([("a", srt.INT32), ("s", srt.STRING)],
+                   {"a": [1, None], "s": ["aa", "b"]})
+    h2 = make_host([("a", srt.INT32), ("s", srt.STRING)],
+                   {"a": [3], "s": [None]})
+    h3 = make_host([("a", srt.INT32), ("s", srt.STRING)],
+                   {"a": [4, 5, 6], "s": ["longer-string-here", "e", "f"]})
+    b1, b2, b3 = (host_to_device(h) for h in (h1, h2, h3))
+    out = concat_batches([b1, b2, b3], capacity=32)
+    assert int(out.num_rows) == 6
+    back = device_to_host(out, names=("a", "s"))
+    assert back.columns[0].to_list() == [1, None, 3, 4, 5, 6]
+    assert back.columns[1].to_list() == ["aa", "b", None,
+                                         "longer-string-here", "e", "f"]
+
+
+def test_gather():
+    hb = make_host([("a", srt.INT32)], {"a": [10, 20, 30, None]})
+    db = host_to_device(hb)
+    idx = jnp.asarray([3, 1, 0, 0, 0, 0, 0, 0])
+    out = db.gather(idx, jnp.asarray(3, jnp.int32))
+    back = device_to_host(out)
+    assert back.columns[0].to_list() == [None, 20, 10]
+
+
+def test_config_docs():
+    from spark_rapids_tpu import config
+    doc = config.generate_docs()
+    assert "spark.rapids.sql.enabled" in doc
+    assert "spark.rapids.sql.batchSizeBytes" in doc
+    c = config.TpuConf({"spark.rapids.sql.enabled": "false"})
+    assert c.sql_enabled is False
+    assert config.TpuConf().sql_enabled is True
+    assert c.get(config.CONCURRENT_TPU_TASKS) == 2
