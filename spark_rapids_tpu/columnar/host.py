@@ -101,6 +101,54 @@ class HostBatch:
         return cls(names, cols)
 
 
+def strings_to_matrix(col: "HostColumn") -> Tuple[np.ndarray, np.ndarray]:
+    """Host string column -> ((n, w) uint8 byte matrix, (n,) int32 lengths).
+
+    The single shared bridge between host object-array strings and the dense
+    device layout; used by every host-path string kernel and by the
+    host->device transition. ``None`` entries (permitted null encoding per
+    HostColumn's contract) become empty strings.
+    """
+    n = len(col.data)
+    vals = [b"" if b is None else bytes(b) for b in col.data]
+    w = max([len(b) for b in vals] + [1])
+    m = np.zeros((n, w), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, b in enumerate(vals):
+        m[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        lens[i] = len(b)
+    return m, lens
+
+
+def matrix_to_strings(data: np.ndarray, lengths: np.ndarray,
+                      validity: np.ndarray) -> "HostColumn":
+    """Inverse of strings_to_matrix (nulls become empty bytes)."""
+    from spark_rapids_tpu.columnar import dtypes as _dt
+    n = data.shape[0]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        out[i] = data[i, :lengths[i]].tobytes() if validity[i] else b""
+    return HostColumn(_dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+@dataclasses.dataclass
+class StringMatrixView:
+    """A host string column viewed in the dense device layout: byte matrix +
+    lengths + validity, carrying its dtype so kernels that branch on
+    ``dtype.is_string`` (blend/repad) work on it. The one shared adapter for
+    every host-path string kernel."""
+
+    dtype: "DataType"
+    data: np.ndarray          # (n, w) uint8
+    lengths: np.ndarray       # (n,) int32
+    validity: np.ndarray      # (n,) bool
+
+    @classmethod
+    def of(cls, col: "HostColumn") -> "StringMatrixView":
+        m, lens = strings_to_matrix(col)
+        return cls(col.dtype, m, lens, col.validity)
+
+
 # ---------------------------------------------------------------------------
 # Transitions (host -> device -> host)
 # ---------------------------------------------------------------------------
@@ -121,20 +169,16 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
         validity = np.zeros(cap, dtype=np.bool_)
         validity[:n] = hc.validity
         if hc.dtype.is_string:
-            max_len = 0
-            for i in range(n):
-                if hc.validity[i]:
-                    max_len = max(max_len, len(hc.data[i]))
-            want = dt.string_width_bucket(max_len)
+            m, lens = strings_to_matrix(hc)
+            lens = np.where(hc.validity, lens, 0)
+            want = dt.string_width_bucket(int(lens.max()) if n else 0)
             if string_widths and name in string_widths:
                 want = max(want, string_widths[name])
             data = np.zeros((cap, want), dtype=np.uint8)
+            w = min(want, m.shape[1])
+            data[:n, :w] = np.where(hc.validity[:, None], m, 0)[:, :w]
             lengths = np.zeros(cap, dtype=np.int32)
-            for i in range(n):
-                if hc.validity[i]:
-                    b = hc.data[i]
-                    data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
-                    lengths[i] = len(b)
+            lengths[:n] = lens
             cols.append(DeviceColumn(hc.dtype, jnp.asarray(data),
                                      jnp.asarray(validity),
                                      jnp.asarray(lengths)))
@@ -158,12 +202,9 @@ def device_to_host(batch: DeviceBatch,
     for c in batch.columns:
         validity = np.asarray(c.validity)[:n]
         if c.dtype.is_string:
-            data_m = np.asarray(c.data)[:n]
-            lengths = np.asarray(c.lengths)[:n]
-            data = np.empty(n, dtype=object)
-            for i in range(n):
-                data[i] = data_m[i, :lengths[i]].tobytes() if validity[i] else b""
-            cols.append(HostColumn(c.dtype, data, validity))
+            cols.append(matrix_to_strings(np.asarray(c.data)[:n],
+                                          np.asarray(c.lengths)[:n],
+                                          validity))
         else:
             data = np.asarray(c.data)[:n].copy()
             data[~validity] = np.zeros(1, c.dtype.np_dtype)

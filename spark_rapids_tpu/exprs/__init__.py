@@ -1,0 +1,34 @@
+"""Expression engine: dual device(jnp)/host(numpy) columnar expressions.
+
+The analog of the reference's GpuExpression library (~150 expressions
+registered in GpuOverrides.scala:537-1667). See base.py for the evaluation
+contract.
+"""
+
+from spark_rapids_tpu.exprs.base import (        # noqa: F401
+    BoundReference, Expression, Literal, Scalar, eval_exprs, eval_exprs_host,
+    lit)
+from spark_rapids_tpu.exprs.arithmetic import (  # noqa: F401
+    Abs, Add, BitwiseAnd, BitwiseNot, BitwiseOr, BitwiseXor, Divide,
+    Greatest, IntegralDivide, Least, Multiply, Pmod, Remainder, ShiftLeft,
+    ShiftRight, ShiftRightUnsigned, Subtract, UnaryMinus, UnaryPositive)
+from spark_rapids_tpu.exprs.predicates import (  # noqa: F401
+    And, EqualNullSafe, EqualTo, GreaterThan, GreaterThanOrEqual, InSet,
+    IsNan, IsNotNull, IsNull, LessThan, LessThanOrEqual, Not, Or)
+from spark_rapids_tpu.exprs.math import (        # noqa: F401
+    Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp, Expm1, Floor, Log,
+    Log1p, Log2, Log10, Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh,
+    ToDegrees, ToRadians)
+from spark_rapids_tpu.exprs.conditional import (  # noqa: F401
+    CaseWhen, Coalesce, If, KnownFloatingPointNormalized, NaNvl,
+    NormalizeNaNAndZero, Nvl)
+from spark_rapids_tpu.exprs.cast import Cast      # noqa: F401
+from spark_rapids_tpu.exprs.datetime import (     # noqa: F401
+    AddMonths, DateAdd, DateDiff, DateSub, DayOfMonth, DayOfWeek, DayOfYear,
+    FromUnixTime, Hour, LastDay, Minute, Month, Quarter, Second, TimeAdd,
+    TimeSub, ToUnixTimestamp, UnixTimestamp, WeekDay, Year)
+from spark_rapids_tpu.exprs.strings import (      # noqa: F401
+    ConcatStrings, Contains, EndsWith, Length, Like, Lower, RegExpReplace,
+    StartsWith, StringLocate, StringReplace, StringTrim, StringTrimLeft,
+    StringTrimRight, Substring, Upper)
+from spark_rapids_tpu.exprs.hash import Murmur3Hash  # noqa: F401

@@ -1,0 +1,263 @@
+"""Arithmetic expressions (ref: sql-plugin .../sql/rapids/arithmetic.scala).
+
+Spark semantics reproduced:
+- ``+ - *`` on numerics use widened common type, overflow wraps (ANSI off).
+- ``Divide`` is always floating (Spark casts operands to double); divide by
+  zero yields NULL, not Inf.
+- ``IntegralDivide`` (``div``) returns long; by-zero -> NULL.
+- ``Remainder`` / ``Pmod``: by-zero -> NULL; sign follows Spark (remainder
+  takes dividend's sign, pmod is non-negative for positive modulus).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, UnaryExpression)
+
+
+class _Arith(BinaryExpression):
+    """Common-type widening binary arithmetic."""
+
+    def data_type(self) -> DataType:
+        return dt.common_numeric_type(self.left.data_type(),
+                                      self.right.data_type())
+
+    def _prep(self, xp, l_data, r_data):
+        t = self.data_type().np_dtype
+        return l_data.astype(t), r_data.astype(t)
+
+
+class Add(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a + b, l_valid & r_valid
+
+
+class Subtract(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a - b, l_valid & r_valid
+
+
+class Multiply(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a * b, l_valid & r_valid
+
+
+class Divide(BinaryExpression):
+    """Spark Divide: operands cast to double; x/0 -> NULL."""
+
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a = l_data.astype(np.float64)
+        b = r_data.astype(np.float64)
+        zero = b == 0.0
+        safe = xp.where(zero, xp.asarray(1.0, dtype=np.float64), b)
+        return a / safe, l_valid & r_valid & ~zero
+
+
+class IntegralDivide(BinaryExpression):
+    """Spark ``div``: long integral quotient, truncated toward zero."""
+
+    def data_type(self) -> DataType:
+        return dt.INT64
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a = l_data.astype(np.int64)
+        b = r_data.astype(np.int64)
+        zero = b == 0
+        safe = xp.where(zero, xp.asarray(1, dtype=np.int64), b)
+        # Java integer division truncates toward zero; xp floor_divide floors.
+        q = xp.floor_divide(a, safe)
+        rem = a - q * safe
+        trunc_fix = (rem != 0) & ((a < 0) != (safe < 0))
+        q = xp.where(trunc_fix, q + 1, q)
+        return q, l_valid & r_valid & ~zero
+
+
+class Remainder(_Arith):
+    """Spark ``%``: result takes the dividend's sign (Java semantics)."""
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        t = self.data_type()
+        zero = b == (0.0 if t.is_floating else 0)
+        one = xp.asarray(1, dtype=t.np_dtype)
+        safe = xp.where(zero, one, b)
+        if t.is_floating:
+            r = xp.fmod(a, safe)
+        else:
+            # xp.remainder floors; convert to truncated (Java) semantics.
+            r = xp.remainder(a, safe)
+            fix = (r != 0) & ((r < 0) != (a < 0))
+            r = xp.where(fix, r - safe, r)
+        return r, l_valid & r_valid & ~zero
+
+
+class Pmod(_Arith):
+    """Spark pmod(a, b): ((a % b) + b) % b."""
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        t = self.data_type()
+        zero = b == (0.0 if t.is_floating else 0)
+        one = xp.asarray(1, dtype=t.np_dtype)
+        safe = xp.where(zero, one, b)
+        if t.is_floating:
+            r = xp.fmod(xp.fmod(a, safe) + safe, safe)
+        else:
+            r = xp.remainder(xp.remainder(a, safe) + safe, safe)
+            fix = (r != 0) & ((r < 0) != (safe < 0))
+            r = xp.where(fix, r - safe, r)
+        return r, l_valid & r_valid & ~zero
+
+
+class UnaryMinus(UnaryExpression):
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        return -data, validity
+
+
+class UnaryPositive(UnaryExpression):
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        return data, validity
+
+
+class Abs(UnaryExpression):
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        return xp.abs(data), validity
+
+
+class Least(Expression):
+    """least(...) — NULLs skipped; NULL only if all inputs NULL."""
+
+    def __init__(self, *children: Expression):
+        self._children = tuple(children)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        t = self._children[0].data_type()
+        for c in self._children[1:]:
+            t = dt.common_numeric_type(t, c.data_type())
+        return t
+
+    _want_smaller = True
+
+    def _lt(self, xp, a, b):
+        """Spark ordering: NaN equal to NaN and greater than everything."""
+        if self.data_type().is_floating:
+            na, nb = xp.isnan(a), xp.isnan(b)
+            return (~na & nb) | ((a < b) & ~na & ~nb)
+        return a < b
+
+    def _fold(self, xp, cols):
+        t = self.data_type()
+        data = None
+        validity = None
+        for d, v in cols:
+            d = d.astype(t.np_dtype)
+            if data is None:
+                data, validity = d, v
+                continue
+            if self._want_smaller:
+                better = self._lt(xp, d, data)
+            else:
+                better = self._lt(xp, data, d)
+            # NULLs are skipped: an invalid accumulator always loses to a
+            # valid operand and vice versa.
+            take_new = v & (~validity | better)
+            data = xp.where(take_new, d, data)
+            validity = validity | v
+        return data, validity
+
+    def eval(self, batch):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.exprs.base import as_device_column, make_column
+        cols = [as_device_column(c.eval(batch), batch) for c in self._children]
+        data, validity = self._fold(jnp, [(c.data, c.validity) for c in cols])
+        return make_column(self.data_type(), data, validity)
+
+    def eval_host(self, batch):
+        from spark_rapids_tpu.exprs.base import as_host_column, make_host_column
+        cols = [as_host_column(c.eval_host(batch), batch)
+                for c in self._children]
+        data, validity = self._fold(np, [(c.data, c.validity) for c in cols])
+        return make_host_column(self.data_type(), data, validity)
+
+
+class Greatest(Least):
+    _want_smaller = False
+
+
+# -- bitwise (ref: .../sql/rapids/bitwise.scala) -----------------------------
+
+class BitwiseAnd(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a & b, l_valid & r_valid
+
+
+class BitwiseOr(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a | b, l_valid & r_valid
+
+
+class BitwiseXor(_Arith):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a, b = self._prep(xp, l_data, r_data)
+        return a ^ b, l_valid & r_valid
+
+
+class BitwiseNot(UnaryExpression):
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        return ~data, validity
+
+
+class ShiftLeft(BinaryExpression):
+    """Java ``<<``: shift count masked to the width of the left operand."""
+
+    def data_type(self) -> DataType:
+        return self.left.data_type()
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        bits = self.data_type().itemsize * 8
+        sh = (r_data.astype(np.int32) & (bits - 1)).astype(l_data.dtype)
+        return l_data << sh, l_valid & r_valid
+
+
+class ShiftRight(ShiftLeft):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        bits = self.data_type().itemsize * 8
+        sh = (r_data.astype(np.int32) & (bits - 1)).astype(l_data.dtype)
+        return l_data >> sh, l_valid & r_valid
+
+
+class ShiftRightUnsigned(ShiftLeft):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        bits = self.data_type().itemsize * 8
+        sh = (r_data.astype(np.int32) & (bits - 1))
+        ut = np.dtype(f"uint{bits}")
+        u = l_data.astype(ut) >> sh.astype(ut)
+        return u.astype(l_data.dtype), l_valid & r_valid

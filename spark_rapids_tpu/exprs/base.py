@@ -1,0 +1,303 @@
+"""Expression engine core: the GpuExpression analog.
+
+Ref: sql-plugin GpuExpressions.scala:63 — ``GpuExpression.columnarEval(batch)``
+returns either a device column or a scalar. Here every expression has TWO
+evaluation paths:
+
+- ``eval(DeviceBatch) -> DeviceColumn | Scalar`` — the TPU path, pure jnp on
+  fixed-capacity columns so it is jit-traceable end to end.
+- ``eval_host(HostBatch) -> HostColumn | Scalar`` — the numpy CPU-fallback
+  path (the stand-in for rows staying on CPU Spark), which doubles as the
+  comparison oracle for the CPU-vs-TPU equality tests (SURVEY.md §4).
+
+Null semantics are SQL three-valued: a row's output validity is the AND of the
+input validities unless an expression overrides it (IsNull, Coalesce, And/Or
+Kleene logic...). Data under dead rows is zeroed so padding stays
+deterministic under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn, _zero_dead
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+
+
+@dataclasses.dataclass(frozen=True)
+class Scalar:
+    """A typed scalar value; ``value is None`` means the SQL NULL literal."""
+
+    dtype: DataType
+    value: Any
+
+    @property
+    def is_null(self) -> bool:
+        return self.value is None
+
+    def as_bytes(self) -> bytes:
+        assert self.dtype.is_string and self.value is not None
+        v = self.value
+        return v.encode("utf-8") if isinstance(v, str) else bytes(v)
+
+
+ColumnLike = Union[DeviceColumn, Scalar]
+HostColumnLike = Union[HostColumn, Scalar]
+
+
+class Expression:
+    """Base expression node (GpuExpressions.scala:63 analog)."""
+
+    def data_type(self) -> DataType:
+        raise NotImplementedError
+
+    @property
+    def children(self) -> Tuple["Expression", ...]:
+        return ()
+
+    def eval(self, batch: DeviceBatch) -> ColumnLike:
+        raise NotImplementedError
+
+    def eval_host(self, batch: HostBatch) -> HostColumnLike:
+        raise NotImplementedError
+
+    @property
+    def self_jittable(self) -> bool:
+        """False when this node's device eval does a host roundtrip."""
+        return True
+
+    @property
+    def jittable(self) -> bool:
+        """True when the whole subtree can run under jax.jit. Non-jittable
+        trees are the expression-level CPU islands: the plan layer keeps them
+        out of compiled programs, mirroring the reference's CPU fallback
+        boundary (RapidsMeta.willNotWorkOnGpu)."""
+        return self.self_jittable and all(c.jittable for c in self.children)
+
+    # Pretty name used by the plan layer's explain output.
+    def pretty(self) -> str:
+        name = type(self).__name__
+        if self.children:
+            return f"{name}({', '.join(c.pretty() for c in self.children)})"
+        return name
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return self.pretty()
+
+
+# ---------------------------------------------------------------------------
+# Materialization helpers: Scalar <-> column broadcasting
+# ---------------------------------------------------------------------------
+
+def expand_scalar(s: Scalar, capacity: int, row_mask: jnp.ndarray,
+                  string_width: Optional[int] = None) -> DeviceColumn:
+    """Broadcast a scalar into a full device column (live rows only)."""
+    if s.dtype.is_string:
+        b = b"" if s.is_null else s.as_bytes()
+        width = string_width or dt.string_width_bucket(len(b))
+        width = max(width, len(b), 1)
+        row = np.zeros(width, dtype=np.uint8)
+        row[:len(b)] = np.frombuffer(b, dtype=np.uint8)
+        validity = row_mask & (not s.is_null)
+        data = jnp.where(validity[:, None], jnp.asarray(row)[None, :],
+                         jnp.zeros((1, width), jnp.uint8))
+        lengths = jnp.where(validity, jnp.int32(len(b)), 0)
+        return DeviceColumn(s.dtype, data, validity, lengths)
+    validity = row_mask & (not s.is_null)
+    fill = s.dtype.np_dtype.type(0 if s.is_null else s.value)
+    data = jnp.where(validity, jnp.asarray(fill), jnp.zeros((), s.dtype.np_dtype))
+    return DeviceColumn(s.dtype, data.astype(s.dtype.np_dtype), validity)
+
+
+def expand_scalar_host(s: Scalar, n: int) -> HostColumn:
+    if s.dtype.is_string:
+        data = np.empty(n, dtype=object)
+        b = b"" if s.is_null else s.as_bytes()
+        for i in range(n):
+            data[i] = b
+        return HostColumn(s.dtype, data,
+                          np.full(n, not s.is_null, dtype=np.bool_))
+    data = np.full(n, 0 if s.is_null else s.value, dtype=s.dtype.np_dtype)
+    return HostColumn(s.dtype, data, np.full(n, not s.is_null, dtype=np.bool_))
+
+
+def as_device_column(v: ColumnLike, batch: DeviceBatch,
+                     string_width: Optional[int] = None) -> DeviceColumn:
+    if isinstance(v, Scalar):
+        return expand_scalar(v, batch.capacity, batch.row_mask(), string_width)
+    return v
+
+
+def as_host_column(v: HostColumnLike, batch: HostBatch) -> HostColumn:
+    if isinstance(v, Scalar):
+        return expand_scalar_host(v, batch.num_rows)
+    return v
+
+
+def make_column(dtype: DataType, data, validity,
+                lengths=None) -> DeviceColumn:
+    """Build a device column, zeroing data under dead rows."""
+    data = _zero_dead(data.astype(dtype.np_dtype) if dtype is not dt.STRING
+                      else data, validity)
+    if dtype.is_string:
+        lengths = jnp.where(validity, lengths, 0)
+        return DeviceColumn(dtype, data, validity, lengths)
+    return DeviceColumn(dtype, data, validity)
+
+
+def make_host_column(dtype: DataType, data, validity) -> HostColumn:
+    if not dtype.is_string:
+        data = np.asarray(data).astype(dtype.np_dtype, copy=True)
+        data[~validity] = np.zeros(1, dtype.np_dtype)
+    else:
+        out = np.empty(len(data), dtype=object)
+        for i in range(len(data)):
+            out[i] = data[i] if validity[i] else b""
+        data = out
+    return HostColumn(dtype, data, np.asarray(validity, dtype=np.bool_))
+
+
+# ---------------------------------------------------------------------------
+# Leaf expressions
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BoundReference(Expression):
+    """Column by ordinal (GpuBoundAttribute.scala analog)."""
+
+    ordinal: int
+    dtype: DataType
+    name: str = ""
+
+    def data_type(self) -> DataType:
+        return self.dtype
+
+    def eval(self, batch: DeviceBatch) -> DeviceColumn:
+        return batch.columns[self.ordinal]
+
+    def eval_host(self, batch: HostBatch) -> HostColumn:
+        return batch.columns[self.ordinal]
+
+    def pretty(self) -> str:
+        return self.name or f"#{self.ordinal}"
+
+
+@dataclasses.dataclass
+class Literal(Expression):
+    """Constant (literals.scala analog). ``value is None`` -> typed NULL."""
+
+    dtype: DataType
+    value: Any
+
+    def data_type(self) -> DataType:
+        return self.dtype
+
+    def eval(self, batch: DeviceBatch) -> Scalar:
+        return Scalar(self.dtype, self.value)
+
+    def eval_host(self, batch: HostBatch) -> Scalar:
+        return Scalar(self.dtype, self.value)
+
+    def pretty(self) -> str:
+        return f"lit({self.value!r})"
+
+
+def lit(value: Any, dtype: Optional[DataType] = None) -> Literal:
+    """Convenience literal builder with python-type inference."""
+    if dtype is None:
+        if isinstance(value, bool):
+            dtype = dt.BOOL
+        elif isinstance(value, int):
+            dtype = dt.INT32 if -2**31 <= value < 2**31 else dt.INT64
+        elif isinstance(value, float):
+            dtype = dt.FLOAT64
+        elif isinstance(value, (str, bytes)):
+            dtype = dt.STRING
+        else:
+            raise TypeError(f"cannot infer literal type for {value!r}")
+    return Literal(dtype, value)
+
+
+# ---------------------------------------------------------------------------
+# Unary / binary templates (GpuUnaryExpression / GpuBinaryExpression analogs)
+# ---------------------------------------------------------------------------
+
+class UnaryExpression(Expression):
+    """Template: null in -> null out; subclass provides the kernel."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def children(self) -> Tuple[Expression, ...]:
+        return (self.child,)
+
+    def do_columnar(self, xp, data, validity, col: DeviceColumn):
+        """Return (data, validity) given raw arrays. ``xp`` is jnp or np."""
+        raise NotImplementedError
+
+    def eval(self, batch: DeviceBatch) -> ColumnLike:
+        v = self.child.eval(batch)
+        col = as_device_column(v, batch)
+        data, validity = self.do_columnar(jnp, col.data, col.validity, col)
+        return make_column(self.data_type(), data, validity)
+
+    def eval_host(self, batch: HostBatch) -> HostColumnLike:
+        v = self.child.eval_host(batch)
+        col = as_host_column(v, batch)
+        data, validity = self.do_columnar(np, col.data, col.validity, col)
+        return make_host_column(self.data_type(), data, validity)
+
+
+class BinaryExpression(Expression):
+    """Template handling scalar/column operand combinations."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left = left
+        self.right = right
+
+    @property
+    def children(self) -> Tuple[Expression, ...]:
+        return (self.left, self.right)
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        """Return (data, validity) from raw operand arrays."""
+        raise NotImplementedError
+
+    def eval(self, batch: DeviceBatch) -> ColumnLike:
+        lv = self.left.eval(batch)
+        rv = self.right.eval(batch)
+        lc = as_device_column(lv, batch)
+        rc = as_device_column(rv, batch)
+        data, validity = self.do_columnar(jnp, lc.data, lc.validity,
+                                          rc.data, rc.validity)
+        return make_column(self.data_type(), data, validity)
+
+    def eval_host(self, batch: HostBatch) -> HostColumnLike:
+        lc = as_host_column(self.left.eval_host(batch), batch)
+        rc = as_host_column(self.right.eval_host(batch), batch)
+        data, validity = self.do_columnar(np, lc.data, lc.validity,
+                                          rc.data, rc.validity)
+        return make_host_column(self.data_type(), data, validity)
+
+
+def eval_exprs(exprs: Sequence[Expression],
+               batch: DeviceBatch) -> DeviceBatch:
+    """Project: evaluate expressions into a new device batch
+    (GpuProjectExec's core, basicPhysicalOperators.scala:66)."""
+    cols = tuple(as_device_column(e.eval(batch), batch) for e in exprs)
+    return DeviceBatch(cols, batch.num_rows)
+
+
+def eval_exprs_host(exprs: Sequence[Expression], batch: HostBatch,
+                    names: Optional[Sequence[str]] = None) -> HostBatch:
+    cols = [as_host_column(e.eval_host(batch), batch) for e in exprs]
+    if names is None:
+        names = tuple(f"c{i}" for i in range(len(cols)))
+    return HostBatch(tuple(names), cols)

@@ -1,0 +1,255 @@
+"""Conditionals & null expressions (ref: conditionalExpressions.scala 251 LoC,
+nullExpressions.scala 297 LoC).
+
+If / CaseWhen / Coalesce / Nvl / NaNvl / NormalizeNaNAndZero. Whole-batch
+evaluation: every branch is evaluated over all rows, results blended with
+``where`` — the columnar trade-off the reference makes too (and exactly what
+XLA ``select`` wants; no divergent control flow).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, UnaryExpression,
+    as_device_column, as_host_column, make_column, make_host_column)
+
+
+def _repad_matrix(xp, data, w: int):
+    """Widen (zero-pad) or narrow a raw (n, cur) byte matrix to width w."""
+    cur = data.shape[1]
+    if cur == w:
+        return data
+    if cur < w:
+        return xp.concatenate(
+            [data, xp.zeros((data.shape[0], w - cur), np.uint8)], axis=1)
+    return data[:, :w]
+
+
+def _blend(xp, cond, a_col, b_col, dtype):
+    """Select a_col where cond else b_col; returns (data, validity, lengths).
+
+    Operates on anything with (data, validity[, lengths]) attributes —
+    including the dtype-less accumulator wrappers CaseWhen/Coalesce fold
+    through — so string widening happens on the raw matrices."""
+    if dtype.is_string:
+        w = max(a_col.data.shape[1], b_col.data.shape[1])
+        a_data = _repad_matrix(xp, a_col.data, w)
+        b_data = _repad_matrix(xp, b_col.data, w)
+        data = xp.where(cond[:, None], a_data, b_data)
+        lengths = xp.where(cond, a_col.lengths, b_col.lengths)
+        validity = xp.where(cond, a_col.validity, b_col.validity)
+        return data, validity, lengths
+    data = xp.where(cond, a_col.data, b_col.data)
+    validity = xp.where(cond, a_col.validity, b_col.validity)
+    return data, validity, None
+
+
+def _host_adapt(col, dtype):
+    from spark_rapids_tpu.columnar.host import StringMatrixView
+    return StringMatrixView.of(col) if dtype.is_string else col
+
+
+def _host_blend(cond, a_col, b_col, dtype):
+    return _blend(np, cond, a_col, b_col, dtype)
+
+
+def _matrix_to_host_strings(data, lengths, validity, dtype):
+    from spark_rapids_tpu.columnar.host import matrix_to_strings
+    return matrix_to_strings(data, lengths, validity)
+
+
+class If(Expression):
+    """if(cond, a, b): Spark's If takes the false branch whenever the
+    predicate is not true — including when it is NULL."""
+
+    def __init__(self, predicate: Expression, true_value: Expression,
+                 false_value: Expression):
+        self.predicate = predicate
+        self.true_value = true_value
+        self.false_value = false_value
+
+    @property
+    def children(self):
+        return (self.predicate, self.true_value, self.false_value)
+
+    def data_type(self) -> DataType:
+        return self.true_value.data_type()
+
+    def eval(self, batch):
+        t = self.data_type()
+        p = as_device_column(self.predicate.eval(batch), batch)
+        a = as_device_column(self.true_value.eval(batch), batch)
+        b = as_device_column(self.false_value.eval(batch), batch)
+        cond = p.data & p.validity
+        data, validity, lengths = _blend(jnp, cond, a, b, t)
+        return make_column(t, data, validity & batch.row_mask(), lengths)
+
+    def eval_host(self, batch):
+        t = self.data_type()
+        p = as_host_column(self.predicate.eval_host(batch), batch)
+        a = _host_adapt(as_host_column(self.true_value.eval_host(batch),
+                                       batch), t)
+        b = _host_adapt(as_host_column(self.false_value.eval_host(batch),
+                                       batch), t)
+        cond = p.data & p.validity
+        data, validity, lengths = _host_blend(cond, a, b, t)
+        if t.is_string:
+            return _matrix_to_host_strings(data, lengths, validity, t)
+        return make_host_column(t, data, validity)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 ... ELSE e END. Branch predicates are evaluated
+    over the whole batch; first-true-wins blending right-to-left."""
+
+    def __init__(self, branches: Sequence[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        self.branches = list(branches)
+        self.else_value = else_value
+
+    @property
+    def children(self):
+        out: List[Expression] = []
+        for c, v in self.branches:
+            out.extend((c, v))
+        if self.else_value is not None:
+            out.append(self.else_value)
+        return tuple(out)
+
+    def data_type(self) -> DataType:
+        return self.branches[0][1].data_type()
+
+    def _run(self, batch, device: bool):
+        t = self.data_type()
+        if device:
+            getcol = lambda e: as_device_column(e.eval(batch), batch)
+            blend = lambda cond, a, b: _blend(jnp, cond, a, b, t)
+        else:
+            getcol = lambda e: _host_adapt(
+                as_host_column(e.eval_host(batch), batch), t)
+            blend = lambda cond, a, b: _host_blend(cond, a, b, t)
+
+        class _Wrap:
+            def __init__(self, data, validity, lengths):
+                self.data = data
+                self.validity = validity
+                self.lengths = lengths
+
+        # Start from the ELSE value (typed NULLs when absent).
+        from spark_rapids_tpu.exprs.base import Literal
+        acc = getcol(self.else_value or Literal(t, None))
+        for cond_e, val_e in reversed(self.branches):
+            c = getcol(cond_e) if device else \
+                as_host_column(cond_e.eval_host(batch), batch)
+            cond = c.data & c.validity
+            acc = _Wrap(*blend(cond, getcol(val_e), acc))
+        return acc
+
+    def eval(self, batch):
+        t = self.data_type()
+        acc = self._run(batch, device=True)
+        return make_column(t, acc.data, acc.validity & batch.row_mask(),
+                           acc.lengths)
+
+    def eval_host(self, batch):
+        t = self.data_type()
+        acc = self._run(batch, device=False)
+        if t.is_string:
+            return _matrix_to_host_strings(acc.data, acc.lengths,
+                                           acc.validity, t)
+        return make_host_column(t, acc.data, acc.validity)
+
+
+class Coalesce(Expression):
+    """First non-null argument (nullExpressions.scala)."""
+
+    def __init__(self, *children: Expression):
+        self._children = tuple(children)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return self._children[0].data_type()
+
+    def eval(self, batch):
+        t = self.data_type()
+        acc = as_device_column(self._children[-1].eval(batch), batch)
+        for e in reversed(self._children[:-1]):
+            c = as_device_column(e.eval(batch), batch)
+            data, validity, lengths = _blend(jnp, c.validity, c, acc, t)
+
+            class _W:
+                pass
+            w = _W()
+            w.data, w.validity, w.lengths = data, validity, lengths
+            acc = w
+        return make_column(t, acc.data, acc.validity & batch.row_mask(),
+                           getattr(acc, "lengths", None))
+
+    def eval_host(self, batch):
+        t = self.data_type()
+        acc = _host_adapt(as_host_column(self._children[-1].eval_host(batch),
+                                         batch), t)
+        for e in reversed(self._children[:-1]):
+            c = _host_adapt(as_host_column(e.eval_host(batch), batch), t)
+            data, validity, lengths = _host_blend(c.validity, c, acc, t)
+
+            class _W:
+                pass
+            w = _W()
+            w.data, w.validity, w.lengths = data, validity, lengths
+            acc = w
+        if t.is_string:
+            return _matrix_to_host_strings(acc.data, acc.lengths,
+                                           acc.validity, t)
+        return make_host_column(t, acc.data, acc.validity)
+
+
+def Nvl(a: Expression, b: Expression) -> Coalesce:
+    return Coalesce(a, b)
+
+
+class NaNvl(BinaryExpression):
+    """nanvl(a, b): b where a is NaN."""
+
+    def data_type(self) -> DataType:
+        return self.left.data_type()
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        nan = xp.isnan(l_data)
+        data = xp.where(nan, r_data.astype(l_data.dtype), l_data)
+        validity = xp.where(nan, r_valid, l_valid)
+        return data, validity
+
+
+class NormalizeNaNAndZero(UnaryExpression):
+    """Canonicalize NaN bit patterns and -0.0 -> 0.0 (Spark's
+    NormalizeFloatingNumbers, used before grouping/joining on floats)."""
+
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        data = xp.where(xp.isnan(data),
+                        xp.asarray(np.nan, dtype=data.dtype), data)
+        data = xp.where(data == 0, xp.abs(data), data)
+        return data, validity
+
+
+class KnownFloatingPointNormalized(UnaryExpression):
+    """Marker pass-through (constraintExpressions.scala)."""
+
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        return data, validity

@@ -1,0 +1,252 @@
+"""Date/time expressions (ref: .../sql/rapids/datetimeExpressions.scala 560).
+
+DATE = days since epoch (int32), TIMESTAMP = UTC micros (int64) — Catalyst's
+internal encodings, so all calendar math is pure integer arithmetic and runs
+on the VPU. Civil-date decomposition uses the days-from-civil algorithm
+(Gregorian, proleptic) in integer ops only — no table lookups, XLA friendly.
+Timezone is UTC-only, same restriction the reference enforces
+(GpuOverrides timezone checks).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import BinaryExpression, UnaryExpression
+
+MICROS_PER_SEC = 1000 * 1000
+MICROS_PER_DAY = 86400 * MICROS_PER_SEC
+
+
+def _fdiv(xp, a, b):
+    return xp.floor_divide(a, b)
+
+
+def civil_from_days(xp, z):
+    """days-since-epoch -> (year, month, day), vectorized integer math."""
+    z = z.astype(np.int64) + 719468
+    era = _fdiv(xp, z, 146097)
+    doe = z - era * 146097
+    yoe = _fdiv(xp, doe - _fdiv(xp, doe, 1460) + _fdiv(xp, doe, 36524)
+                - _fdiv(xp, doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + _fdiv(xp, yoe, 4) - _fdiv(xp, yoe, 100))
+    mp = _fdiv(xp, 5 * doy + 2, 153)
+    d = doy - _fdiv(xp, 153 * mp + 2, 5) + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(np.int32), m.astype(np.int32), d.astype(np.int32)
+
+
+def days_from_civil(xp, y, m, d):
+    """(year, month, day) -> days since epoch."""
+    y = y.astype(np.int64) - (m <= 2)
+    era = _fdiv(xp, y, 400)
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9).astype(np.int64)
+    doy = _fdiv(xp, 153 * mp + 2, 5) + d.astype(np.int64) - 1
+    doe = yoe * 365 + _fdiv(xp, yoe, 4) - _fdiv(xp, yoe, 100) + doy
+    return (era * 146097 + doe - 719468).astype(np.int32)
+
+
+def _days_of(xp, data, src: DataType):
+    if src.name == "timestamp":
+        return _fdiv(xp, data, MICROS_PER_DAY)
+    return data
+
+
+class _DatePart(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def _part(self, xp, y, m, d, days):
+        raise NotImplementedError
+
+    def do_columnar(self, xp, data, validity, col):
+        days = _days_of(xp, data, self.child.data_type())
+        y, m, d = civil_from_days(xp, days)
+        return self._part(xp, y, m, d, days), validity
+
+
+class Year(_DatePart):
+    def _part(self, xp, y, m, d, days):
+        return y
+
+
+class Month(_DatePart):
+    def _part(self, xp, y, m, d, days):
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def _part(self, xp, y, m, d, days):
+        return d
+
+
+class Quarter(_DatePart):
+    def _part(self, xp, y, m, d, days):
+        return _fdiv(xp, m - 1, 3).astype(np.int32) + 1
+
+
+class DayOfWeek(_DatePart):
+    """Spark: Sunday=1 ... Saturday=7. Epoch day 0 was a Thursday."""
+
+    def _part(self, xp, y, m, d, days):
+        return (xp.remainder(days.astype(np.int64) + 4, 7) + 1) \
+            .astype(np.int32)
+
+
+class WeekDay(_DatePart):
+    """Spark weekday(): Monday=0 ... Sunday=6."""
+
+    def _part(self, xp, y, m, d, days):
+        return xp.remainder(days.astype(np.int64) + 3, 7).astype(np.int32)
+
+
+class DayOfYear(_DatePart):
+    def _part(self, xp, y, m, d, days):
+        jan1 = days_from_civil(xp, y, xp.ones_like(m), xp.ones_like(d))
+        return (days - jan1 + 1).astype(np.int32)
+
+
+class LastDay(UnaryExpression):
+    """Last day of the month of the given date."""
+
+    def data_type(self) -> DataType:
+        return dt.DATE
+
+    def do_columnar(self, xp, data, validity, col):
+        days = _days_of(xp, data, self.child.data_type())
+        y, m, d = civil_from_days(xp, days)
+        ny = xp.where(m == 12, y + 1, y)
+        nm = xp.where(m == 12, 1, m + 1)
+        first_next = days_from_civil(xp, ny, nm, xp.ones_like(d))
+        return first_next - 1, validity
+
+
+class _TimePart(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def do_columnar(self, xp, data, validity, col):
+        secs_in_day = _fdiv(xp, xp.remainder(data, MICROS_PER_DAY),
+                            MICROS_PER_SEC)
+        return self._part(xp, secs_in_day), validity
+
+    def _part(self, xp, secs):
+        raise NotImplementedError
+
+
+class Hour(_TimePart):
+    def _part(self, xp, secs):
+        return _fdiv(xp, secs, 3600).astype(np.int32)
+
+
+class Minute(_TimePart):
+    def _part(self, xp, secs):
+        return xp.remainder(_fdiv(xp, secs, 60), 60).astype(np.int32)
+
+
+class Second(_TimePart):
+    def _part(self, xp, secs):
+        return xp.remainder(secs, 60).astype(np.int32)
+
+
+class DateAdd(BinaryExpression):
+    """date_add(date, n days)."""
+
+    def data_type(self) -> DataType:
+        return dt.DATE
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (l_data.astype(np.int32) + r_data.astype(np.int32),
+                l_valid & r_valid)
+
+
+class DateSub(BinaryExpression):
+    def data_type(self) -> DataType:
+        return dt.DATE
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (l_data.astype(np.int32) - r_data.astype(np.int32),
+                l_valid & r_valid)
+
+
+class DateDiff(BinaryExpression):
+    """datediff(end, start) in days."""
+
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (l_data.astype(np.int32) - r_data.astype(np.int32),
+                l_valid & r_valid)
+
+
+class AddMonths(BinaryExpression):
+    """add_months(date, n): clamps the day to the target month's end."""
+
+    def data_type(self) -> DataType:
+        return dt.DATE
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        days = l_data.astype(np.int64)
+        y, m, d = civil_from_days(xp, days)
+        months = y.astype(np.int64) * 12 + (m - 1) + r_data.astype(np.int64)
+        ny = _fdiv(xp, months, 12).astype(np.int32)
+        nm = xp.remainder(months, 12).astype(np.int32) + 1
+        # clamp day to last day of target month
+        nny = xp.where(nm == 12, ny + 1, ny)
+        nnm = xp.where(nm == 12, 1, nm + 1)
+        last = days_from_civil(xp, nny, nnm, xp.ones_like(nm)) - \
+            days_from_civil(xp, ny, nm, xp.ones_like(nm))
+        nd = xp.minimum(d, last.astype(np.int32))
+        return days_from_civil(xp, ny, nm, nd), l_valid & r_valid
+
+
+class TimeAdd(BinaryExpression):
+    """timestamp + interval-micros (ref: GpuTimeSub shim rule, inverted).
+
+    The right child must evaluate to int64 micros (CalendarInterval with only
+    the microseconds field set, the same restriction the reference enforces at
+    Spark300Shims TimeSub)."""
+
+    def data_type(self) -> DataType:
+        return dt.TIMESTAMP
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (l_data.astype(np.int64) + r_data.astype(np.int64),
+                l_valid & r_valid)
+
+
+class TimeSub(TimeAdd):
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (l_data.astype(np.int64) - r_data.astype(np.int64),
+                l_valid & r_valid)
+
+
+class ToUnixTimestamp(UnaryExpression):
+    """Seconds since epoch from timestamp/date (default format path)."""
+
+    def data_type(self) -> DataType:
+        return dt.INT64
+
+    def do_columnar(self, xp, data, validity, col):
+        if self.child.data_type().name == "date":
+            return data.astype(np.int64) * 86400, validity
+        return _fdiv(xp, data, MICROS_PER_SEC), validity
+
+
+UnixTimestamp = ToUnixTimestamp
+
+
+class FromUnixTime(UnaryExpression):
+    """Seconds -> timestamp (the string-format variant goes through cast)."""
+
+    def data_type(self) -> DataType:
+        return dt.TIMESTAMP
+
+    def do_columnar(self, xp, data, validity, col):
+        return data.astype(np.int64) * MICROS_PER_SEC, validity

@@ -1,0 +1,190 @@
+"""Spark-compatible Murmur3 hash (ref: HashFunctions.scala:39 + cudf's
+Spark-flavored murmur3, used by GpuHashPartitioning for shuffle parity).
+
+Implements org.apache.spark.unsafe.hash.Murmur3_x86_32 exactly, vectorized in
+int32 lane arithmetic (uint32 on device to sidestep signed-overflow):
+- bool/byte/short/int/date -> hashInt
+- long/timestamp -> hashLong (two 4-byte blocks, low then high)
+- float -> hashInt(floatToIntBits) with -0.0 kept as is (Spark hashes raw
+  bits; NaN canonicalized to the single Java NaN bit pattern)
+- double -> hashLong(doubleToLongBits), same NaN canonicalization
+- string -> hashUnsafeBytes: 4-byte little-endian blocks then per-byte tail
+  (bytes are SIGNED in the tail, matching the JVM)
+- NULL columns pass the running seed through unchanged
+- multi-column: seed chains left to right starting at 42
+
+Bit-for-bit parity with Spark here is what makes TPU shuffle partitions line
+up with CPU Spark's (SURVEY.md §7 step 2 "murmur3-compatible hash").
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import (
+    Expression, as_device_column, as_host_column, make_column,
+    make_host_column)
+
+DEFAULT_SEED = 42
+
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
+
+
+def _u32(xp, x):
+    return x.astype(np.uint32)
+
+
+def _rotl(xp, x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k1(xp, k1):
+    k1 = k1 * _C1
+    k1 = _rotl(xp, k1, 15)
+    return k1 * _C2
+
+
+def _mix_h1(xp, h1, k1):
+    h1 = h1 ^ k1
+    h1 = _rotl(xp, h1, 13)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _fmix(xp, h1, length):
+    # length may be a per-row array (string hashing) or a python int.
+    length = np.uint32(length) if isinstance(length, int) \
+        else length.astype(np.uint32)
+    h1 = h1 ^ length
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    return h1
+
+
+def hash_int(xp, value_i32, seed_u32):
+    """Murmur3 of one 4-byte value (already int32 lanes)."""
+    k1 = _mix_k1(xp, _u32(xp, value_i32))
+    h1 = _mix_h1(xp, seed_u32, k1)
+    return _fmix(xp, h1, 4)
+
+
+def hash_long(xp, value_i64, seed_u32):
+    v = value_i64.astype(np.uint64)
+    low = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    high = (v >> np.uint64(32)).astype(np.uint32)
+    h1 = _mix_h1(xp, seed_u32, _mix_k1(xp, low))
+    h1 = _mix_h1(xp, h1, _mix_k1(xp, high))
+    return _fmix(xp, h1, 8)
+
+
+def _float_bits(xp, data):
+    """Java floatToIntBits: canonicalize NaN to 0x7FC00000."""
+    bits = data.astype(np.float32).view(np.int32) if xp is np else \
+        jnp.asarray(data, np.float32).view(jnp.int32)
+    nan = xp.isnan(data)
+    return xp.where(nan, np.int32(0x7FC00000), bits)
+
+
+def _double_bits(xp, data):
+    bits = data.astype(np.float64).view(np.int64) if xp is np else \
+        jnp.asarray(data, np.float64).view(jnp.int64)
+    nan = xp.isnan(data)
+    return xp.where(nan, np.int64(0x7FF8000000000000), bits)
+
+
+def hash_string_matrix(xp, data, lengths, seed_u32):
+    """hashUnsafeBytes over a (N, W) byte matrix with per-row lengths.
+
+    Block loop runs W//4 iterations of dense lane ops; tail bytes are folded
+    with a masked per-byte pass. All trace-time loops are over the static
+    width, so XLA unrolls and fuses them.
+    """
+    n, w = data.shape
+    h1 = seed_u32
+    nblocks_row = lengths // 4
+    # 4-byte little-endian words.
+    nwords = w // 4
+    for bi in range(nwords):
+        b0 = data[:, bi * 4].astype(np.uint32)
+        b1 = data[:, bi * 4 + 1].astype(np.uint32)
+        b2 = data[:, bi * 4 + 2].astype(np.uint32)
+        b3 = data[:, bi * 4 + 3].astype(np.uint32)
+        word = b0 | (b1 << np.uint32(8)) | (b2 << np.uint32(16)) | \
+            (b3 << np.uint32(24))
+        mixed = _mix_h1(xp, h1, _mix_k1(xp, word))
+        h1 = xp.where(bi < nblocks_row, mixed, h1)
+    # Tail: signed bytes hashed one at a time as ints.
+    aligned = nblocks_row * 4
+    for off in range(w):
+        byte = data[:, off].astype(np.int8).astype(np.int32)
+        k1 = _mix_k1(xp, _u32(xp, byte))
+        mixed = _mix_h1(xp, h1, k1)
+        in_tail = (off >= aligned) & (off < lengths)
+        h1 = xp.where(in_tail, mixed, h1)
+    return _fmix(xp, h1, lengths.astype(np.uint32))
+
+
+def hash_column(xp, col, dtype: DataType, seed_u32):
+    """Hash one column, passing the seed through for NULL rows."""
+    if dtype.is_string:
+        h = hash_string_matrix(xp, col.data, col.lengths, seed_u32)
+    elif dtype.name in ("int64", "timestamp"):
+        h = hash_long(xp, col.data, seed_u32)
+    elif dtype.name == "float64":
+        h = hash_long(xp, _double_bits(xp, col.data), seed_u32)
+    elif dtype.name == "float32":
+        h = hash_int(xp, _float_bits(xp, col.data), seed_u32)
+    elif dtype.is_boolean:
+        h = hash_int(xp, col.data.astype(np.int32), seed_u32)
+    else:  # int8/16/32/date widen to int
+        h = hash_int(xp, col.data.astype(np.int32), seed_u32)
+    return xp.where(col.validity, h, seed_u32)
+
+
+class Murmur3Hash(Expression):
+    """hash(c1, c2, ...) -> int32, seed chained across columns."""
+
+    def __init__(self, children: Sequence[Expression],
+                 seed: int = DEFAULT_SEED):
+        self._children = tuple(children)
+        self.seed = seed
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def _run(self, xp, cols, n):
+        h = xp.full((n,), np.uint32(np.uint32(self.seed)), dtype=np.uint32)
+        for col, dtype in cols:
+            h = hash_column(xp, col, dtype, h)
+        return h.astype(np.int32)
+
+    def eval(self, batch):
+        cols = [(as_device_column(c.eval(batch), batch), c.data_type())
+                for c in self._children]
+        data = self._run(jnp, cols, batch.capacity)
+        return make_column(dt.INT32, data, batch.row_mask())
+
+    def eval_host(self, batch):
+        from spark_rapids_tpu.columnar.host import StringMatrixView
+        cols = []
+        for c in self._children:
+            hc = as_host_column(c.eval_host(batch), batch)
+            if c.data_type().is_string:
+                cols.append((StringMatrixView.of(hc), c.data_type()))
+            else:
+                cols.append((hc, c.data_type()))
+        data = self._run(np, cols, batch.num_rows)
+        return make_host_column(dt.INT32, data,
+                                np.ones(batch.num_rows, np.bool_))

@@ -1,0 +1,233 @@
+"""Math expressions (ref: .../sql/rapids/mathExpressions.scala 378 LoC).
+
+Unary math functions follow Spark: inputs are cast to double, domain errors
+produce NaN (not NULL), log of non-positive is NULL in Spark? No — Spark's
+``log`` returns NULL for non-positive input. We match Spark: ``ln/log10/log2/
+log1p`` return NULL for out-of-domain, others produce NaN like java.lang.Math.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import BinaryExpression, UnaryExpression
+
+
+class _UnaryMathD(UnaryExpression):
+    """double -> double math fn."""
+
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def _fn(self, xp, x):
+        raise NotImplementedError
+
+    def do_columnar(self, xp, data, validity, col):
+        return self._fn(xp, data.astype(np.float64)), validity
+
+
+class Sqrt(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.sqrt(x)
+
+
+class Cbrt(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.cbrt(x)
+
+
+class Exp(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.exp(x)
+
+
+class Expm1(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.expm1(x)
+
+
+class _LogBase(UnaryExpression):
+    """Spark logs return NULL outside the domain."""
+
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def _fn(self, xp, x):
+        raise NotImplementedError
+
+    def _domain_ok(self, xp, x):
+        return x > 0
+
+    def do_columnar(self, xp, data, validity, col):
+        x = data.astype(np.float64)
+        ok = self._domain_ok(xp, x)
+        safe = xp.where(ok, x, xp.asarray(1.0))
+        return self._fn(xp, safe), validity & ok
+
+
+class Log(_LogBase):
+    def _fn(self, xp, x):
+        return xp.log(x)
+
+
+class Log10(_LogBase):
+    def _fn(self, xp, x):
+        return xp.log10(x)
+
+
+class Log2(_LogBase):
+    def _fn(self, xp, x):
+        return xp.log2(x)
+
+
+class Log1p(_LogBase):
+    def _domain_ok(self, xp, x):
+        return x > -1
+
+    def _fn(self, xp, x):
+        return xp.log1p(x)
+
+
+class Sin(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.sin(x)
+
+
+class Cos(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.cos(x)
+
+
+class Tan(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.tan(x)
+
+
+class Asin(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.arcsin(x)
+
+
+class Acos(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.arccos(x)
+
+
+class Atan(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.arctan(x)
+
+
+class Sinh(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.sinh(x)
+
+
+class Cosh(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.cosh(x)
+
+
+class Tanh(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.tanh(x)
+
+
+class ToDegrees(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.degrees(x)
+
+
+class ToRadians(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.radians(x)
+
+
+class Signum(_UnaryMathD):
+    def _fn(self, xp, x):
+        return xp.sign(x)
+
+
+class Rint(_UnaryMathD):
+    """Math.rint: round half to even."""
+
+    def _fn(self, xp, x):
+        return xp.round(x)
+
+
+class Floor(UnaryExpression):
+    """Spark floor returns LONG for numeric input."""
+
+    def data_type(self) -> DataType:
+        return dt.INT64
+
+    def do_columnar(self, xp, data, validity, col):
+        return xp.floor(data.astype(np.float64)).astype(np.int64), validity
+
+
+class Ceil(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.INT64
+
+    def do_columnar(self, xp, data, validity, col):
+        return xp.ceil(data.astype(np.float64)).astype(np.int64), validity
+
+
+class Pow(BinaryExpression):
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        a = l_data.astype(np.float64)
+        b = r_data.astype(np.float64)
+        return xp.power(a, b), l_valid & r_valid
+
+
+class Atan2(BinaryExpression):
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        return (xp.arctan2(l_data.astype(np.float64),
+                           r_data.astype(np.float64)),
+                l_valid & r_valid)
+
+
+class Round(UnaryExpression):
+    """round(x, d): HALF_UP like Spark (not banker's rounding).
+
+    The scale must be a literal (same restriction as the reference's
+    GpuRound) — it is a static python int so jit sees a constant.
+    """
+
+    def __init__(self, child, scale=0):
+        super().__init__(child)
+        from spark_rapids_tpu.exprs.base import Literal
+        if isinstance(scale, Literal):
+            scale = scale.value
+        self.scale = int(scale)
+
+    def data_type(self) -> DataType:
+        return self.child.data_type()
+
+    def do_columnar(self, xp, data, validity, col):
+        t = self.data_type()
+        if t.is_integral:
+            # Exact integer path: float64 would corrupt |x| > 2^53.
+            if self.scale >= 0:
+                return data, validity
+            factor = np.int64(10) ** np.int64(-self.scale)
+            x = data.astype(np.int64)
+            mag = xp.abs(x) + factor // 2
+            r = xp.floor_divide(mag, factor) * factor
+            r = xp.where(x < 0, -r, r)
+            return r.astype(t.np_dtype), validity
+        factor = 10.0 ** self.scale
+        x = data.astype(np.float64)
+        # HALF_UP: away from zero on ties.
+        scaled = x * factor
+        r = xp.where(scaled >= 0, xp.floor(scaled + 0.5),
+                     xp.ceil(scaled - 0.5)) / factor
+        return r, validity

@@ -1,0 +1,285 @@
+"""Predicates & comparisons (ref: .../sql/rapids/predicates.scala 631 LoC).
+
+Spark comparison semantics reproduced exactly:
+- NaN is equal to NaN and greater than every other double/float value
+  (Spark diverges from IEEE here; see Spark's ``NaN semantics`` docs).
+- And/Or use Kleene three-valued logic (false && null = false,
+  true || null = true).
+- EqualNullSafe (``<=>``) never returns NULL.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, Scalar, UnaryExpression,
+    as_device_column, as_host_column, make_column, make_host_column)
+
+
+def _string_cmp(xp, l_data, l_len, r_data, r_len):
+    """Lexicographic byte compare of two (N, W) padded matrices.
+
+    Returns (lt, eq) bool arrays. Zero padding is safe because comparison is
+    on unsigned bytes and real lengths break ties.
+    """
+    wl, wr = l_data.shape[1], r_data.shape[1]
+    w = max(wl, wr)
+    if wl < w:
+        l_data = xp.concatenate(
+            [l_data, xp.zeros((l_data.shape[0], w - wl), np.uint8)], axis=1)
+    if wr < w:
+        r_data = xp.concatenate(
+            [r_data, xp.zeros((r_data.shape[0], w - wr), np.uint8)], axis=1)
+    li = l_data.astype(np.int16)
+    ri = r_data.astype(np.int16)
+    diff = li - ri                       # (N, W); first nonzero decides
+    nz = diff != 0
+    # Index of first nonzero byte; W if none differ.
+    first = xp.where(nz.any(axis=1), xp.argmax(nz, axis=1), w)
+    idx = xp.minimum(first, w - 1)
+    d = xp.take_along_axis(diff, idx[:, None], axis=1)[:, 0]
+    bytes_eq = first == w
+    eq = bytes_eq & (l_len == r_len)
+    lt = xp.where(bytes_eq, l_len < r_len, d < 0)
+    return lt, eq
+
+
+class _Comparison(BinaryExpression):
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def _lt_eq(self, xp, l_col, r_col):
+        """Compute (lt, eq) with Spark NaN ordering for floats."""
+        t = self.left.data_type()
+        if t.is_string:
+            return _string_cmp(xp, l_col.data, l_col.lengths,
+                               r_col.data, r_col.lengths)
+        a, b = l_col.data, r_col.data
+        if t.is_floating:
+            na, nb = xp.isnan(a), xp.isnan(b)
+            eq = (a == b) | (na & nb)
+            lt = (~na & nb) | ((a < b) & ~na & ~nb)
+            return lt, eq
+        return a < b, a == b
+
+    def _cmp_eval(self, xp, l_col, r_col, pick):
+        lt, eq = self._lt_eq(xp, l_col, r_col)
+        return pick(lt, eq), l_col.validity & r_col.validity
+
+    def _pick(self, lt, eq):
+        raise NotImplementedError
+
+    def eval(self, batch):
+        import jax.numpy as jnp
+        lc = as_device_column(self.left.eval(batch), batch)
+        rc = as_device_column(self.right.eval(batch), batch)
+        data, validity = self._cmp_eval(jnp, lc, rc, self._pick)
+        return make_column(dt.BOOL, data, validity)
+
+    def eval_host(self, batch):
+        lc = as_host_column(self.left.eval_host(batch), batch)
+        rc = as_host_column(self.right.eval_host(batch), batch)
+        if self.left.data_type().is_string:
+            lc = _host_strings_to_matrix(lc)
+            rc = _host_strings_to_matrix(rc)
+        data, validity = self._cmp_eval(np, lc, rc, self._pick)
+        return make_host_column(dt.BOOL, data, validity)
+
+
+def _host_strings_to_matrix(col):
+    from spark_rapids_tpu.columnar.host import StringMatrixView
+    return StringMatrixView.of(col)
+
+
+class EqualTo(_Comparison):
+    def _pick(self, lt, eq):
+        return eq
+
+
+class LessThan(_Comparison):
+    def _pick(self, lt, eq):
+        return lt
+
+
+class LessThanOrEqual(_Comparison):
+    def _pick(self, lt, eq):
+        return lt | eq
+
+
+class GreaterThan(_Comparison):
+    def _pick(self, lt, eq):
+        return ~(lt | eq)
+
+
+class GreaterThanOrEqual(_Comparison):
+    def _pick(self, lt, eq):
+        return ~lt
+
+
+class EqualNullSafe(_Comparison):
+    """``<=>``: NULL <=> NULL is true; never returns NULL."""
+
+    def _cmp_eval(self, xp, l_col, r_col, pick):
+        lt, eq = self._lt_eq(xp, l_col, r_col)
+        lv, rv = l_col.validity, r_col.validity
+        data = (lv & rv & eq) | (~lv & ~rv)
+        return data, xp.ones_like(data, dtype=np.bool_)
+
+    def _pick(self, lt, eq):  # pragma: no cover - unused
+        return eq
+
+    def eval(self, batch):
+        import jax.numpy as jnp
+        lc = as_device_column(self.left.eval(batch), batch)
+        rc = as_device_column(self.right.eval(batch), batch)
+        data, _ = self._cmp_eval(jnp, lc, rc, None)
+        # Padding rows must still be invalid.
+        return make_column(dt.BOOL, data, batch.row_mask())
+
+    def eval_host(self, batch):
+        lc = as_host_column(self.left.eval_host(batch), batch)
+        rc = as_host_column(self.right.eval_host(batch), batch)
+        if self.left.data_type().is_string:
+            lc = _host_strings_to_matrix(lc)
+            rc = _host_strings_to_matrix(rc)
+        data, _ = self._cmp_eval(np, lc, rc, None)
+        return make_host_column(dt.BOOL, data,
+                                np.ones(batch.num_rows, np.bool_))
+
+
+class Not(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def do_columnar(self, xp, data, validity, col):
+        return ~data, validity
+
+
+class And(BinaryExpression):
+    """Kleene: F & x = F even when x is NULL."""
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        l_false = l_valid & ~l_data
+        r_false = r_valid & ~r_data
+        data = l_data & r_data
+        validity = (l_valid & r_valid) | l_false | r_false
+        return data & l_valid & r_valid, validity
+
+
+class Or(BinaryExpression):
+    """Kleene: T | x = T even when x is NULL."""
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        l_true = l_valid & l_data
+        r_true = r_valid & r_data
+        data = l_true | r_true
+        validity = (l_valid & r_valid) | l_true | r_true
+        return data, validity
+
+
+class IsNull(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        return make_column(dt.BOOL, ~col.validity, batch.row_mask())
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        return make_host_column(dt.BOOL, ~col.validity,
+                                np.ones(batch.num_rows, np.bool_))
+
+    def do_columnar(self, xp, data, validity, col):  # pragma: no cover
+        raise AssertionError
+
+
+class IsNotNull(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        return make_column(dt.BOOL, col.validity, batch.row_mask())
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        return make_host_column(dt.BOOL, col.validity,
+                                np.ones(batch.num_rows, np.bool_))
+
+    def do_columnar(self, xp, data, validity, col):  # pragma: no cover
+        raise AssertionError
+
+
+class IsNan(UnaryExpression):
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def do_columnar(self, xp, data, validity, col):
+        return xp.isnan(data), validity
+
+
+class InSet(Expression):
+    """value IN (literals) — ref GpuInSet.scala. NULL semantics: if the value
+    is NULL, the result is NULL; if no match and the list has a NULL, NULL."""
+
+    def __init__(self, child: Expression, values: Sequence):
+        self.child = child
+        self.values = tuple(values)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def _run(self, xp, col, batch_cls):
+        t = self.child.data_type()
+        has_null = any(v is None for v in self.values)
+        present = [v for v in self.values if v is not None]
+        if t.is_string:
+            lens = col.lengths
+            acc = xp.zeros(col.data.shape[0], dtype=np.bool_)
+            for v in present:
+                b = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                w = col.data.shape[1]
+                target = np.zeros(w, dtype=np.uint8)
+                target[:min(len(b), w)] = np.frombuffer(
+                    b[:w], dtype=np.uint8)
+                hit = ((col.data == xp.asarray(target)[None, :]).all(axis=1)
+                       & (lens == len(b)))
+                acc = acc | hit
+        else:
+            acc = xp.zeros(col.data.shape[0], dtype=np.bool_)
+            for v in present:
+                if t.is_floating and isinstance(v, float) and np.isnan(v):
+                    acc = acc | xp.isnan(col.data)
+                else:
+                    acc = acc | (col.data == t.np_dtype.type(v))
+        validity = col.validity & (acc | (not has_null))
+        return acc, validity
+
+    def eval(self, batch):
+        import jax.numpy as jnp
+        col = as_device_column(self.child.eval(batch), batch)
+        data, validity = self._run(jnp, col, None)
+        return make_column(dt.BOOL, data, validity)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        if self.child.data_type().is_string:
+            col = _host_strings_to_matrix(col)
+        data, validity = self._run(np, col, None)
+        return make_host_column(dt.BOOL, data, validity)

@@ -1,0 +1,678 @@
+"""String expressions (ref: .../sql/rapids/stringFunctions.scala 862 LoC).
+
+TPU-first string layout: each column is a dense ``(N, W) uint8`` matrix plus
+int32 lengths (columnar/batch.py). Every op below is expressed as dense
+vector ops over that matrix (VPU-friendly), not gathers over a ragged heap:
+
+- upper/lower: branchless ASCII case flip (locale-sensitive Unicode casing is
+  the same incompat the reference flags on GpuUpper/GpuLower).
+- length/substring: UTF-8 aware via the char-start mask
+  ``(b & 0xC0) != 0x80`` and cumulative sums.
+- contains/startswith/endswith/locate/like: sliding-window equality over the
+  width axis — O(W * |needle|) fused elementwise work instead of per-row
+  loops.
+- byte packing (left-compaction after substring/trim) via a stable argsort on
+  the keep mask — XLA lowers this to a bitonic sort over W lanes.
+
+replace / regexp_replace route through the host engine (python re), same
+boundary the reference draws at GpuRegExpReplace's cudf limitations.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import (
+    BinaryExpression, Expression, Scalar, UnaryExpression,
+    as_device_column, as_host_column, make_column, make_host_column)
+
+
+# ---------------------------------------------------------------------------
+# Dense byte-matrix primitives
+# ---------------------------------------------------------------------------
+
+def byte_mask(xp, width: int, lengths) -> "np.ndarray":
+    """(N, W) bool — True for bytes inside the string."""
+    return xp.arange(width, dtype=np.int32)[None, :] < lengths[:, None]
+
+
+def char_starts(xp, data, lengths):
+    """(N, W) bool — True at the first byte of each UTF-8 codepoint."""
+    return ((data & 0xC0) != 0x80) & byte_mask(xp, data.shape[1], lengths)
+
+
+def pack_left(xp, data, keep) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Compact kept bytes to the left of each row; returns (data, lengths)."""
+    w = data.shape[1]
+    key = (~keep).astype(np.int8)
+    if xp is np:
+        order = np.argsort(key, axis=1, kind="stable")
+    else:
+        order = xp.argsort(key, axis=1, stable=True)
+    packed = xp.take_along_axis(data, order, axis=1)
+    counts = keep.sum(axis=1).astype(np.int32)
+    live = xp.arange(w, dtype=np.int32)[None, :] < counts[:, None]
+    return xp.where(live, packed, 0), counts
+
+
+def _char_count(xp, data, lengths):
+    return char_starts(xp, data, lengths).sum(axis=1).astype(np.int32)
+
+
+from spark_rapids_tpu.columnar.host import (
+    matrix_to_strings as _matrix_to_host, strings_to_matrix as
+    _host_to_matrix)
+
+
+class StringUnary(Expression):
+    """Template for string->string ops defined on the byte matrix."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def kernel(self, xp, data, lengths, validity):
+        """Return (data, lengths, validity)."""
+        raise NotImplementedError
+
+    def eval(self, batch: DeviceBatch):
+        col = as_device_column(self.child.eval(batch), batch)
+        data, lengths, validity = self.kernel(jnp, col.data, col.lengths,
+                                              col.validity)
+        return make_column(dt.STRING, data, validity, lengths)
+
+    def eval_host(self, batch: HostBatch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        m, lens = _host_to_matrix(col)
+        data, lengths, validity = self.kernel(np, m, lens, col.validity)
+        return _matrix_to_host(data, lengths, validity)
+
+
+class Upper(StringUnary):
+    def kernel(self, xp, data, lengths, validity):
+        lower = (data >= ord("a")) & (data <= ord("z"))
+        return xp.where(lower, data - 32, data), lengths, validity
+
+
+class Lower(StringUnary):
+    def kernel(self, xp, data, lengths, validity):
+        upper = (data >= ord("A")) & (data <= ord("Z"))
+        return xp.where(upper, data + 32, data), lengths, validity
+
+
+class Length(Expression):
+    """Character (codepoint) length, like Spark's length()."""
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        n = _char_count(jnp, col.data, col.lengths)
+        return make_column(dt.INT32, n, col.validity)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        m, lens = _host_to_matrix(col)
+        n = _char_count(np, m, lens)
+        return make_host_column(dt.INT32, n, col.validity)
+
+
+class Substring(Expression):
+    """substring(str, pos, len) — 1-based, character-addressed, negative pos
+    counts from the end (Spark semantics; ref GpuSubstring)."""
+
+    def __init__(self, child: Expression, pos: Expression, length: Expression):
+        self.child = child
+        self.pos = pos
+        self.length = length
+
+    @property
+    def children(self):
+        return (self.child, self.pos, self.length)
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def _kernel(self, xp, data, lengths, validity, pos, slen):
+        w = data.shape[1]
+        starts = char_starts(xp, data, lengths)
+        nchars = starts.sum(axis=1).astype(np.int64)
+        # char index of each byte (0-based); bytes of char k get k.
+        cidx = (xp.cumsum(starts.astype(np.int32), axis=1) - 1) \
+            .astype(np.int64)
+        # int64 throughout: substr(s, pos) desugars to len = Int.MaxValue,
+        # and start + len must not wrap.
+        pos = pos.astype(np.int64)
+        slen = xp.maximum(slen.astype(np.int64), 0)
+        # Spark: pos>0 -> 1-based from start; pos<0 -> from end; pos==0 -> 1.
+        start = xp.where(pos > 0, pos - 1,
+                         xp.where(pos < 0, nchars + pos, 0))
+        start0 = xp.maximum(start, 0)
+        end = start0 + xp.where(start < 0,
+                                xp.maximum(slen + start, 0), slen)
+        inside = byte_mask(xp, w, lengths)
+        keep = inside & (cidx >= start0[:, None]) & (cidx < end[:, None])
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, validity
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        p = as_device_column(self.pos.eval(batch), batch)
+        l = as_device_column(self.length.eval(batch), batch)
+        data, lengths, validity = self._kernel(
+            jnp, col.data, col.lengths,
+            col.validity & p.validity & l.validity, p.data, l.data)
+        return make_column(dt.STRING, data, validity, lengths)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        p = as_host_column(self.pos.eval_host(batch), batch)
+        l = as_host_column(self.length.eval_host(batch), batch)
+        m, lens = _host_to_matrix(col)
+        data, lengths, validity = self._kernel(
+            np, m, lens, col.validity & p.validity & l.validity,
+            p.data, l.data)
+        return _matrix_to_host(data, lengths, validity)
+
+
+def _sliding_match(xp, data, lengths, needle: bytes):
+    """(N, W) bool — True at byte offset i iff needle matches starting at i
+    and fits inside the string."""
+    n, w = data.shape
+    m = len(needle)
+    if m == 0:
+        return byte_mask(xp, w, lengths + 1)  # empty matches everywhere
+    if m > w:
+        return xp.zeros((n, w), dtype=np.bool_)
+    acc = xp.ones((n, w), dtype=np.bool_)
+    for j, byte in enumerate(needle):
+        # data shifted left by j: data[:, i+j] compared to needle[j]
+        shifted = xp.concatenate(
+            [data[:, j:], xp.zeros((n, j), np.uint8)], axis=1)
+        acc = acc & (shifted == byte)
+    fits = (xp.arange(w, dtype=np.int32)[None, :]
+            <= (lengths - m)[:, None])
+    return acc & fits
+
+
+class _NeedleOp(Expression):
+    """Binary string op whose right side must be a literal (same restriction
+    the reference places on Like/StartsWith/EndsWith needles)."""
+
+    def __init__(self, child: Expression, needle: Expression):
+        self.child = child
+        self.needle = needle
+
+    @property
+    def children(self):
+        return (self.child, self.needle)
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def _needle_bytes(self, batch, device: bool) -> Tuple[bytes, bool]:
+        v = self.needle.eval(batch) if device else \
+            self.needle.eval_host(batch)
+        assert isinstance(v, Scalar), \
+            f"{type(self).__name__} needle must be a literal"
+        if v.is_null:
+            return b"", True
+        return v.as_bytes(), False
+
+    def _match(self, xp, data, lengths, needle: bytes):
+        raise NotImplementedError
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        needle, null = self._needle_bytes(batch, True)
+        if null:
+            return make_column(dt.BOOL,
+                               jnp.zeros(batch.capacity, np.bool_),
+                               jnp.zeros(batch.capacity, np.bool_))
+        data = self._match(jnp, col.data, col.lengths, needle)
+        return make_column(dt.BOOL, data, col.validity)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        needle, null = self._needle_bytes(batch, False)
+        if null:
+            z = np.zeros(batch.num_rows, np.bool_)
+            return make_host_column(dt.BOOL, z, z.copy())
+        m, lens = _host_to_matrix(col)
+        data = self._match(np, m, lens, needle)
+        return make_host_column(dt.BOOL, data, col.validity)
+
+
+class Contains(_NeedleOp):
+    def _match(self, xp, data, lengths, needle):
+        return _sliding_match(xp, data, lengths, needle).any(axis=1)
+
+
+class StartsWith(_NeedleOp):
+    def _match(self, xp, data, lengths, needle):
+        hits = _sliding_match(xp, data, lengths, needle)
+        return hits[:, 0] if hits.shape[1] > 0 else \
+            xp.zeros(data.shape[0], np.bool_)
+
+
+class EndsWith(_NeedleOp):
+    def _match(self, xp, data, lengths, needle):
+        hits = _sliding_match(xp, data, lengths, needle)
+        m = len(needle)
+        w = data.shape[1]
+        pos = xp.clip(lengths - m, 0, max(w - 1, 0))
+        at_end = xp.take_along_axis(hits, pos[:, None].astype(np.int32),
+                                    axis=1)[:, 0]
+        return at_end & (lengths >= m)
+
+
+class StringLocate(Expression):
+    """locate(needle, str, start=1): 1-based char position of first match at
+    or after ``start``; 0 if absent (ref GpuStringLocate)."""
+
+    def __init__(self, needle: Expression, child: Expression,
+                 start: Expression):
+        self.needle = needle
+        self.child = child
+        self.start = start
+
+    @property
+    def children(self):
+        return (self.needle, self.child, self.start)
+
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def _kernel(self, xp, data, lengths, needle: bytes, start):
+        w = data.shape[1]
+        hits = _sliding_match(xp, data, lengths, needle)
+        starts = char_starts(xp, data, lengths)
+        cidx = xp.cumsum(starts.astype(np.int32), axis=1) - 1  # char of byte
+        # Only hits at char starts count; char position must be >= start-1.
+        ok = hits & starts & (cidx >= (start - 1)[:, None])
+        any_hit = ok.any(axis=1)
+        first_byte = xp.argmax(ok, axis=1)
+        charpos = xp.take_along_axis(
+            cidx, first_byte[:, None].astype(np.int32), axis=1)[:, 0] + 1
+        res = xp.where(any_hit, charpos, 0)
+        # Empty needle: Spark returns start if start <= len+1, else 0.
+        if len(needle) == 0:
+            res = xp.where(start <= _char_count(xp, data, lengths) + 1,
+                           start, 0)
+        # Spark short-circuits any start < 1 to 0.
+        return xp.where(start >= 1, res, 0).astype(np.int32)
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        nv = self.needle.eval(batch)
+        sv = as_device_column(self.start.eval(batch), batch)
+        assert isinstance(nv, Scalar), "locate needle must be a literal"
+        if nv.is_null:
+            z = jnp.zeros(batch.capacity, np.bool_)
+            return make_column(dt.INT32,
+                               jnp.zeros(batch.capacity, np.int32), z)
+        data = self._kernel(jnp, col.data, col.lengths, nv.as_bytes(),
+                            sv.data.astype(np.int32))
+        return make_column(dt.INT32, data, col.validity & sv.validity)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        nv = self.needle.eval_host(batch)
+        sv = as_host_column(self.start.eval_host(batch), batch)
+        if nv.is_null:
+            z = np.zeros(batch.num_rows, np.bool_)
+            return make_host_column(dt.INT32,
+                                    np.zeros(batch.num_rows, np.int32), z)
+        m, lens = _host_to_matrix(col)
+        data = self._kernel(np, m, lens, nv.as_bytes(),
+                            sv.data.astype(np.int32))
+        return make_host_column(dt.INT32, data, col.validity & sv.validity)
+
+
+class ConcatStrings(Expression):
+    """concat(s1, s2, ...): NULL if any input NULL (Spark concat).
+
+    Device kernel: output byte j of row r comes from whichever input the
+    running length prefix places there — computed with shifted gathers, no
+    per-row loops.
+    """
+
+    def __init__(self, *children: Expression):
+        self._children = tuple(children)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def _concat2(self, xp, a_data, a_len, b_data, b_len):
+        n = a_data.shape[0]
+        wa, wb = a_data.shape[1], b_data.shape[1]
+        w = wa + wb
+        j = xp.arange(w, dtype=np.int32)[None, :]            # (1, W)
+        from_a = j < a_len[:, None]
+        # byte index into b for output position j
+        bj = xp.clip(j - a_len[:, None], 0, max(wb - 1, 0))
+        a_pad = xp.concatenate(
+            [a_data, xp.zeros((n, w - wa), np.uint8)], axis=1)
+        b_g = xp.take_along_axis(
+            xp.concatenate([b_data, xp.zeros((n, w - wb), np.uint8)], axis=1),
+            bj, axis=1)
+        out = xp.where(from_a, a_pad, b_g)
+        out_len = a_len + b_len
+        live = xp.arange(w, dtype=np.int32)[None, :] < out_len[:, None]
+        return xp.where(live, out, 0), out_len
+
+    def _run(self, xp, cols):
+        data, lengths, validity = cols[0]
+        for d, l, v in cols[1:]:
+            data, lengths = self._concat2(xp, data, lengths, d, l)
+            validity = validity & v
+        return data, lengths, validity
+
+    def eval(self, batch):
+        cols = []
+        for c in self._children:
+            col = as_device_column(c.eval(batch), batch)
+            cols.append((col.data, col.lengths, col.validity))
+        data, lengths, validity = self._run(
+            jnp, [(d, l, v) for d, l, v in cols])
+        return make_column(dt.STRING, data, validity, lengths)
+
+    def eval_host(self, batch):
+        cols = []
+        for c in self._children:
+            col = as_host_column(c.eval_host(batch), batch)
+            m, lens = _host_to_matrix(col)
+            cols.append((m, lens, col.validity))
+        data, lengths, validity = self._run(np, cols)
+        return _matrix_to_host(data, lengths, validity)
+
+
+class StringTrim(StringUnary):
+    """trim(): strip leading+trailing spaces (0x20), like Spark default.
+
+    All-space strings trim to empty (``keep &= has``)."""
+
+    def kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        inside = byte_mask(xp, w, lengths)
+        nonspace = inside & (data != 0x20)
+        idx = xp.arange(w, dtype=np.int32)[None, :]
+        has = nonspace.any(axis=1)
+        big = xp.where(nonspace, idx, w)
+        first = xp.where(has, big.min(axis=1), 0)
+        small = xp.where(nonspace, idx, -1)
+        last = xp.where(has, small.max(axis=1), -1)
+        keep = inside & (idx >= first[:, None]) & (idx < (last + 1)[:, None])
+        keep = keep & has[:, None]
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, validity
+
+
+class StringTrimLeft(StringTrim):
+    def kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        inside = byte_mask(xp, w, lengths)
+        nonspace = inside & (data != 0x20)
+        idx = xp.arange(w, dtype=np.int32)[None, :]
+        has = nonspace.any(axis=1)
+        big = xp.where(nonspace, idx, w)
+        first = xp.where(has, big.min(axis=1), lengths)
+        keep = inside & (idx >= first[:, None])
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, validity
+
+
+class StringTrimRight(StringTrim):
+    def kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        inside = byte_mask(xp, w, lengths)
+        nonspace = inside & (data != 0x20)
+        idx = xp.arange(w, dtype=np.int32)[None, :]
+        has = nonspace.any(axis=1)
+        small = xp.where(nonspace, idx, -1)
+        last = xp.where(has, small.max(axis=1) + 1, 0)
+        keep = inside & (idx < last[:, None])
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, validity
+
+
+class _HostStringOp(Expression):
+    """Template for ops that run on host even in the device plan (regex and
+    friends — the boundary the reference draws at cudf's regex support)."""
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    @property
+    def self_jittable(self) -> bool:
+        return False
+
+    def _host_kernel(self, values, validity):
+        raise NotImplementedError
+
+    def _strings_of(self, col: HostColumn):
+        return [bytes(b) for b in col.data]
+
+    def eval(self, batch):
+        from spark_rapids_tpu.columnar.host import device_to_host, host_to_device
+        col = as_device_column(self.children[0].eval(batch), batch)
+        tmp = DeviceBatch((col,), batch.num_rows)
+        hb = device_to_host(tmp)
+        out = self._host_kernel(self._strings_of(hb.columns[0]),
+                                hb.columns[0].validity)
+        dev = host_to_device(HostBatch(("c",), [out]),
+                             capacity=batch.capacity)
+        return dev.columns[0]
+
+    def eval_host(self, batch):
+        col = as_host_column(self.children[0].eval_host(batch), batch)
+        return self._host_kernel(self._strings_of(col), col.validity)
+
+
+class StringReplace(_HostStringOp):
+    """replace(str, search, replace) with literal search (GpuStringReplace)."""
+
+    def __init__(self, child: Expression, search: str, replace: str):
+        self.child = child
+        self.search = search.encode() if isinstance(search, str) else search
+        self.replace = replace.encode() if isinstance(replace, str) \
+            else replace
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _host_kernel(self, values, validity):
+        n = len(values)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if validity[i] and len(self.search):
+                out[i] = values[i].replace(self.search, self.replace)
+            else:
+                out[i] = values[i]
+        return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class RegExpReplace(_HostStringOp):
+    """regexp_replace with literal pattern (host engine, python re)."""
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        import re
+        self.child = child
+        self.pattern = re.compile(pattern.encode()
+                                  if isinstance(pattern, str) else pattern)
+        self.replacement = replacement.encode() \
+            if isinstance(replacement, str) else replacement
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _host_kernel(self, values, validity):
+        n = len(values)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = self.pattern.sub(self.replacement, values[i]) \
+                if validity[i] else b""
+        return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class Like(Expression):
+    """SQL LIKE. The pattern must be a literal. Patterns made only of literal
+    segments and ``%`` compile to fused device contains/prefix/suffix matches;
+    anything with ``_`` falls back to the host matcher (same split the
+    reference makes for GpuLike's cudf `matchesRe`)."""
+
+    def __init__(self, child: Expression, pattern: str, escape: str = "\\"):
+        self.child = child
+        self.pattern = pattern
+        self.escape = escape
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    @property
+    def self_jittable(self) -> bool:
+        return self._segments() is not None
+
+    def _segments(self):
+        """Split the pattern on unescaped %; returns None if '_' present."""
+        segs = []
+        cur = []
+        i = 0
+        p = self.pattern
+        while i < len(p):
+            ch = p[i]
+            if ch == self.escape and i + 1 < len(p):
+                cur.append(p[i + 1])
+                i += 2
+                continue
+            if ch == "_":
+                return None
+            if ch == "%":
+                segs.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+            i += 1
+        segs.append("".join(cur))
+        return segs
+
+    def _device_match(self, xp, data, lengths):
+        segs = self._segments()
+        assert segs is not None
+        n, w = data.shape
+        bsegs = [s.encode() for s in segs]
+        total = sum(len(b) for b in bsegs)
+        ok = lengths >= total
+        if len(bsegs) == 1:
+            # exact match
+            b = bsegs[0]
+            target = np.zeros(w, dtype=np.uint8)
+            target[:min(len(b), w)] = np.frombuffer(b[:w], np.uint8)
+            return ((data == xp.asarray(target)[None, :]).all(axis=1)
+                    & (lengths == len(b)))
+        # prefix
+        if bsegs[0]:
+            hits = _sliding_match(xp, data, lengths, bsegs[0])
+            ok = ok & (hits[:, 0] if w else False)
+        # suffix
+        if bsegs[-1]:
+            b = bsegs[-1]
+            hits = _sliding_match(xp, data, lengths, b)
+            pos = xp.clip(lengths - len(b), 0, max(w - 1, 0))
+            ok = ok & (xp.take_along_axis(
+                hits, pos[:, None].astype(np.int32), axis=1)[:, 0]
+                & (lengths >= len(b)))
+        # middles: ordered, non-overlapping containment. Track the earliest
+        # position each segment can start from.
+        min_start = xp.full((n,), len(bsegs[0]), dtype=np.int32)
+        for b in bsegs[1:-1]:
+            if not b:
+                continue
+            hits = _sliding_match(xp, data, lengths, b)
+            idx = xp.arange(w, dtype=np.int32)[None, :]
+            usable = hits & (idx >= min_start[:, None])
+            any_hit = usable.any(axis=1)
+            first = xp.argmax(usable, axis=1).astype(np.int32)
+            ok = ok & any_hit
+            min_start = first + len(b)
+        if bsegs[-1]:
+            ok = ok & ((lengths - len(bsegs[-1])) >= min_start) \
+                if len(bsegs) > 1 else ok
+        return ok
+
+    def _host_match(self, values, validity):
+        import re
+        # Translate LIKE to an anchored regex.
+        out = []
+        p = self.pattern
+        rx = []
+        i = 0
+        while i < len(p):
+            ch = p[i]
+            if ch == self.escape and i + 1 < len(p):
+                rx.append(re.escape(p[i + 1]))
+                i += 2
+                continue
+            if ch == "%":
+                rx.append(".*")
+            elif ch == "_":
+                rx.append(".")
+            else:
+                rx.append(re.escape(ch))
+            i += 1
+        pat = re.compile("(?s)^" + "".join(rx) + "$")
+        for i, b in enumerate(values):
+            out.append(bool(validity[i])
+                       and pat.match(b.decode("utf-8", "replace")) is not None)
+        return np.asarray(out, dtype=np.bool_)
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        if self._segments() is not None:
+            data = self._device_match(jnp, col.data, col.lengths)
+            return make_column(dt.BOOL, data, col.validity)
+        # '_' patterns: host roundtrip.
+        from spark_rapids_tpu.columnar.host import device_to_host, host_to_device
+        hb = device_to_host(DeviceBatch((col,), batch.num_rows))
+        vals = [bytes(b) for b in hb.columns[0].data]
+        res = self._host_match(vals, hb.columns[0].validity)
+        hc = HostColumn(dt.BOOL, res, hb.columns[0].validity.copy())
+        dev = host_to_device(HostBatch(("c",), [hc]), capacity=batch.capacity)
+        return dev.columns[0]
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        vals = [bytes(b) for b in col.data]
+        res = self._host_match(vals, col.validity)
+        return make_host_column(dt.BOOL, res, col.validity)

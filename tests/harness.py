@@ -1,0 +1,144 @@
+"""Dual-path compare harness (ref: SparkQueryCompareTestSuite.scala:153-161).
+
+The reference runs every test body twice — CPU Spark vs GPU plugin — and
+compares collected results. Here the two engines are the host (numpy)
+expression/operator path and the device (jnp under jit) path; both must
+produce identical python-level results, with float tolerance knobs mirroring
+``approximate_float``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, host_to_device, \
+    device_to_host
+from spark_rapids_tpu.exprs.base import (
+    Expression, eval_exprs, eval_exprs_host)
+
+
+def assert_rows_equal(actual, expected, approx_float: bool = False,
+                      msg: str = ""):
+    assert len(actual) == len(expected), \
+        f"{msg}: row count {len(actual)} != {len(expected)}"
+    for r, (a_row, e_row) in enumerate(zip(actual, expected)):
+        assert len(a_row) == len(e_row), f"{msg}: row {r} width differs"
+        for c, (a, e) in enumerate(zip(a_row, e_row)):
+            if a is None or e is None:
+                assert a is None and e is None, \
+                    f"{msg}: [{r}][{c}] {a!r} != {e!r}"
+                continue
+            if isinstance(e, float):
+                if math.isnan(e):
+                    assert isinstance(a, float) and math.isnan(a), \
+                        f"{msg}: [{r}][{c}] {a!r} != NaN"
+                elif approx_float:
+                    assert a == e or abs(a - e) <= 1e-6 * max(
+                        1.0, abs(e)), f"{msg}: [{r}][{c}] {a!r} !~ {e!r}"
+                else:
+                    assert a == e, f"{msg}: [{r}][{c}] {a!r} != {e!r}"
+            else:
+                assert a == e, f"{msg}: [{r}][{c}] {a!r} != {e!r}"
+
+
+def check_exprs(exprs: Sequence[Expression], batch: HostBatch,
+                expected: Optional[Sequence[tuple]] = None,
+                approx_float: bool = False):
+    """Evaluate on host and device (jit), compare, return device rows."""
+    host_out = eval_exprs_host(exprs, batch).to_pylist()
+
+    dev_in = host_to_device(batch)
+
+    if all(e.jittable for e in exprs):
+        run = jax.jit(lambda b: eval_exprs(exprs, b))
+    else:
+        # Expression-level CPU island: runs eagerly with host roundtrips.
+        run = lambda b: eval_exprs(exprs, b)
+
+    dev_batch = run(dev_in)
+    dev_out = device_to_host(dev_batch).to_pylist()
+
+    assert_rows_equal(dev_out, host_out, approx_float,
+                      "device vs host engine")
+    if expected is not None:
+        assert_rows_equal(dev_out, list(expected), approx_float,
+                          "device vs oracle")
+    return dev_out
+
+
+def check_expr(expr: Expression, batch: HostBatch,
+               expected: Optional[Sequence] = None,
+               approx_float: bool = False):
+    exp = None if expected is None else [(e,) for e in expected]
+    rows = check_exprs([expr], batch, exp, approx_float)
+    return [r[0] for r in rows]
+
+
+# ---------------------------------------------------------------------------
+# Pure-python scalar Murmur3_x86_32 oracle (independent of the vector impl)
+# ---------------------------------------------------------------------------
+
+_M = 0xFFFFFFFF
+
+
+def _py_rotl(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _py_mix_k1(k1):
+    k1 = (k1 * 0xCC9E2D51) & _M
+    k1 = _py_rotl(k1, 15)
+    return (k1 * 0x1B873593) & _M
+
+
+def _py_mix_h1(h1, k1):
+    h1 ^= _py_mix_k1(k1) if False else k1  # k1 already mixed by caller
+    h1 = _py_rotl(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _M
+
+
+def _py_fmix(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M
+    h1 ^= h1 >> 16
+    return h1
+
+
+def py_hash_int(value, seed):
+    h1 = _py_mix_h1(seed & _M, _py_mix_k1(value & _M))
+    return _py_fmix(h1, 4)
+
+
+def py_hash_long(value, seed):
+    v = value & 0xFFFFFFFFFFFFFFFF
+    low = v & _M
+    high = (v >> 32) & _M
+    h1 = _py_mix_h1(seed & _M, _py_mix_k1(low))
+    h1 = _py_mix_h1(h1, _py_mix_k1(high))
+    return _py_fmix(h1, 8)
+
+
+def py_hash_bytes(data: bytes, seed):
+    h1 = seed & _M
+    nblocks = len(data) // 4
+    for i in range(nblocks):
+        word = int.from_bytes(data[i * 4:(i + 1) * 4], "little")
+        h1 = _py_mix_h1(h1, _py_mix_k1(word))
+    for i in range(nblocks * 4, len(data)):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # signed byte, like the JVM
+        h1 = _py_mix_h1(h1, _py_mix_k1(b & _M))
+    return _py_fmix(h1, len(data))
+
+
+def to_signed32(v):
+    v &= _M
+    return v - (1 << 32) if v >= (1 << 31) else v
