@@ -228,15 +228,17 @@ def _parse_value(b: bytes, to: DataType):
                 return float("-inf"), True
             return float(s), True
         if to.name == "date":
-            # ISO yyyy-mm-dd (Spark accepts yyyy, yyyy-mm too).
-            parts = s.split("-")
-            if len(parts) == 1:
-                d = np.datetime64(f"{int(parts[0]):04d}-01-01", "D")
-            elif len(parts) == 2:
-                d = np.datetime64(
-                    f"{int(parts[0]):04d}-{int(parts[1]):02d}-01", "D")
-            else:
-                d = np.datetime64(s[:10], "D")
+            # ISO yyyy[-mm[-dd]] only; trailing garbage -> NULL like Spark.
+            import re as _re
+            m = _re.fullmatch(r"(\d{4,5})(?:-(\d{1,2})(?:-(\d{1,2}))?)?", s)
+            if not m:
+                return None, False
+            y = int(m.group(1))
+            mo = int(m.group(2) or 1)
+            dd = int(m.group(3) or 1)
+            if not (1 <= mo <= 12 and 1 <= dd <= 31):
+                return None, False
+            d = np.datetime64(f"{y:04d}-{mo:02d}-{dd:02d}", "D")
             return int(d.astype("datetime64[D]").astype(np.int64)), True
         if to.name == "timestamp":
             t = s.replace(" ", "T")

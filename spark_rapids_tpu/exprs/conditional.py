@@ -9,6 +9,7 @@ XLA ``select`` wants; no divergent control flow).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
@@ -58,6 +59,16 @@ def _host_adapt(col, dtype):
 
 def _host_blend(cond, a_col, b_col, dtype):
     return _blend(np, cond, a_col, b_col, dtype)
+
+
+@dataclasses.dataclass
+class _Acc:
+    """The (data, validity, lengths) accumulator CaseWhen/Coalesce fold
+    through — shaped like a column for _blend but dtype-agnostic."""
+
+    data: object
+    validity: object
+    lengths: object = None
 
 
 def _matrix_to_host_strings(data, lengths, validity, dtype):
@@ -136,12 +147,6 @@ class CaseWhen(Expression):
                 as_host_column(e.eval_host(batch), batch), t)
             blend = lambda cond, a, b: _host_blend(cond, a, b, t)
 
-        class _Wrap:
-            def __init__(self, data, validity, lengths):
-                self.data = data
-                self.validity = validity
-                self.lengths = lengths
-
         # Start from the ELSE value (typed NULLs when absent).
         from spark_rapids_tpu.exprs.base import Literal
         acc = getcol(self.else_value or Literal(t, None))
@@ -149,7 +154,7 @@ class CaseWhen(Expression):
             c = getcol(cond_e) if device else \
                 as_host_column(cond_e.eval_host(batch), batch)
             cond = c.data & c.validity
-            acc = _Wrap(*blend(cond, getcol(val_e), acc))
+            acc = _Acc(*blend(cond, getcol(val_e), acc))
         return acc
 
     def eval(self, batch):
@@ -185,13 +190,7 @@ class Coalesce(Expression):
         acc = as_device_column(self._children[-1].eval(batch), batch)
         for e in reversed(self._children[:-1]):
             c = as_device_column(e.eval(batch), batch)
-            data, validity, lengths = _blend(jnp, c.validity, c, acc, t)
-
-            class _W:
-                pass
-            w = _W()
-            w.data, w.validity, w.lengths = data, validity, lengths
-            acc = w
+            acc = _Acc(*_blend(jnp, c.validity, c, acc, t))
         return make_column(t, acc.data, acc.validity & batch.row_mask(),
                            getattr(acc, "lengths", None))
 
@@ -201,13 +200,7 @@ class Coalesce(Expression):
                                          batch), t)
         for e in reversed(self._children[:-1]):
             c = _host_adapt(as_host_column(e.eval_host(batch), batch), t)
-            data, validity, lengths = _host_blend(c.validity, c, acc, t)
-
-            class _W:
-                pass
-            w = _W()
-            w.data, w.validity, w.lengths = data, validity, lengths
-            acc = w
+            acc = _Acc(*_host_blend(c.validity, c, acc, t))
         if t.is_string:
             return _matrix_to_host_strings(acc.data, acc.lengths,
                                            acc.validity, t)

@@ -1,0 +1,12 @@
+"""Physical operators (the GpuExec layer, SURVEY.md §2.4)."""
+
+from spark_rapids_tpu.ops.base import (         # noqa: F401
+    DeviceToHostExec, Exec, ExecContext, HostToDeviceExec,
+    InMemorySourceExec, Metrics, Schema)
+from spark_rapids_tpu.ops.basic import (        # noqa: F401
+    CoalescePartitionsExec, ExpandExec, FilterExec, GlobalLimitExec,
+    LocalLimitExec, ProjectExec, RangeExec, UnionExec)
+from spark_rapids_tpu.ops.sort import SortExec, SortOrder  # noqa: F401
+from spark_rapids_tpu.ops.aggregate import (    # noqa: F401
+    AggSpec, Average, Count, CountStar, First, HashAggregateExec, Last, Max,
+    Min, Sum)

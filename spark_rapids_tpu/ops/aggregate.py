@@ -1,0 +1,707 @@
+"""Hash aggregate (ref: aggregate.scala:305 — the 4-stage pipeline
+documented at aggregate.scala:397-425, re-designed for TPU).
+
+Device algorithm per partition (mirrors the reference's iterative loop at
+aggregate.scala:427-480):
+
+  for each input batch:
+      project grouping keys + aggregate inputs
+      group_ids (fingerprint sort) + segmented update aggregation
+      -> partial buffer batch [keys..., buffers...]
+      concat with the running partial; when the concat grows past the
+      merge threshold, re-merge (group again with merge aggregates)
+  final merge once at end; in final/complete mode run the result
+  projection (finalize avg, rename columns)
+
+All kernels are fixed-capacity jnp programs; the number of groups is a
+device scalar so data-dependent group counts never recompile. Buffers are
+(data, validity, lengths-or-None) triples so string aggregates (min/max/
+first/last over strings) flow through the same machinery.
+
+Aggregate functions (ref: AggregateFunctions.scala as CudfAggregate
+update/merge pairs): Count, Sum, Min, Max, Average, First, Last. Each also
+carries a host-side update/merge/finalize so the host oracle engine runs
+real partial/final plans, not just single-stage ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity, concat_batches)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import (
+    Expression, as_device_column, as_host_column)
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops import kernels
+
+
+@dataclasses.dataclass
+class SortedCol:
+    """One column's arrays permuted to group-sorted order."""
+
+    data: jnp.ndarray
+    validity: jnp.ndarray
+    lengths: Optional[jnp.ndarray] = None   # strings only
+
+
+Buf = Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]
+
+
+# ---------------------------------------------------------------------------
+# Aggregate function descriptors
+# ---------------------------------------------------------------------------
+
+class AggFunction:
+    """One aggregate: an input expression plus update/merge/finalize logic
+    over segmented reductions. ``buffer_types`` is the partial-buffer schema
+    this function contributes."""
+
+    def __init__(self, child: Optional[Expression]):
+        self.child = child
+
+    @property
+    def buffer_types(self) -> Tuple[dt.DataType, ...]:
+        raise NotImplementedError
+
+    @property
+    def result_type(self) -> dt.DataType:
+        raise NotImplementedError
+
+    # -- device ---------------------------------------------------------
+    def update(self, col: SortedCol, gid, capacity,
+               row_index) -> List[Buf]:
+        raise NotImplementedError
+
+    def merge(self, bufs: List[SortedCol], gid, capacity) -> List[Buf]:
+        raise NotImplementedError
+
+    def finalize(self, bufs: List[SortedCol]) -> Buf:
+        raise NotImplementedError
+
+    # -- host oracle ----------------------------------------------------
+    def host_update(self, values: list) -> tuple:
+        """Group's python values (None=null) -> buffer value tuple."""
+        raise NotImplementedError
+
+    def host_merge(self, buf_tuples: List[tuple]) -> tuple:
+        raise NotImplementedError
+
+    def host_finalize(self, buf: tuple):
+        raise NotImplementedError
+
+    def host_agg(self, values: list):
+        return self.host_finalize(self.host_merge([self.host_update(values)]))
+
+
+class Count(AggFunction):
+    """count(x): non-null count; see CountStar for count(*)."""
+
+    @property
+    def buffer_types(self):
+        return (dt.INT64,)
+
+    @property
+    def result_type(self):
+        return dt.INT64
+
+    def update(self, col, gid, capacity, row_index):
+        cnt = jax.ops.segment_sum(col.validity.astype(jnp.int64), gid,
+                                  num_segments=capacity)
+        return [(cnt, jnp.ones((capacity,), jnp.bool_), None)]
+
+    def merge(self, bufs, gid, capacity):
+        b, = bufs
+        s = jax.ops.segment_sum(jnp.where(b.validity, b.data, 0), gid,
+                                num_segments=capacity)
+        return [(s, jnp.ones((capacity,), jnp.bool_), None)]
+
+    def finalize(self, bufs):
+        b, = bufs
+        return b.data, b.validity, None
+
+    def host_update(self, values):
+        return (sum(1 for v in values if v is not None),)
+
+    def host_merge(self, buf_tuples):
+        return (sum(b[0] for b in buf_tuples if b[0] is not None),)
+
+    def host_finalize(self, buf):
+        return buf[0]
+
+
+class CountStar(Count):
+    def host_update(self, values):
+        return (len(values),)
+
+
+def _sum_result_type(t: dt.DataType) -> dt.DataType:
+    return dt.FLOAT64 if t.is_floating else dt.INT64
+
+
+class Sum(AggFunction):
+    @property
+    def buffer_types(self):
+        return (_sum_result_type(self.child.data_type()),)
+
+    @property
+    def result_type(self):
+        return _sum_result_type(self.child.data_type())
+
+    def update(self, col, gid, capacity, row_index):
+        t = self.result_type.np_dtype
+        agg, counts = kernels.segment_reduce(
+            col.data.astype(t), col.validity, gid, capacity, "sum")
+        return [(agg, counts > 0, None)]
+
+    def merge(self, bufs, gid, capacity):
+        b, = bufs
+        agg, counts = kernels.segment_reduce(b.data, b.validity, gid,
+                                             capacity, "sum")
+        return [(agg, counts > 0, None)]
+
+    def finalize(self, bufs):
+        b, = bufs
+        return b.data, b.validity, None
+
+    def host_update(self, values):
+        vs = [v for v in values if v is not None]
+        if not vs:
+            return (None,)
+        if self.result_type.is_floating:
+            return (float(np.sum(np.asarray(vs, np.float64))),)
+        acc = np.int64(0)
+        with np.errstate(over="ignore"):
+            for v in vs:
+                acc = np.int64(acc + np.int64(v))   # JVM wrap
+        return (int(acc),)
+
+    def host_merge(self, buf_tuples):
+        return self.host_update([b[0] for b in buf_tuples])
+
+    def host_finalize(self, buf):
+        return buf[0]
+
+
+class Min(AggFunction):
+    kind = "min"
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type(),)
+
+    @property
+    def result_type(self):
+        return self.child.data_type()
+
+    def update(self, col, gid, capacity, row_index):
+        if col.lengths is not None:
+            return [kernels.segment_minmax_string(
+                col.data, col.lengths, col.validity, gid, capacity,
+                want_max=self.kind == "max")]
+        agg, counts = kernels.segment_reduce(col.data, col.validity, gid,
+                                             capacity, self.kind)
+        return [(agg, counts > 0, None)]
+
+    def merge(self, bufs, gid, capacity):
+        return self.update(bufs[0], gid, capacity, None)
+
+    def finalize(self, bufs):
+        b, = bufs
+        return b.data, b.validity, b.lengths
+
+    def host_update(self, values):
+        vs = [v for v in values if v is not None]
+        if not vs:
+            return (None,)
+        t = self.child.data_type()
+        if t.is_floating:
+            non_nan = [v for v in vs if not np.isnan(v)]
+            if self.kind == "min":
+                return (min(non_nan) if non_nan else float("nan"),)
+            return (float("nan") if len(non_nan) < len(vs)
+                    else max(vs),)
+        return (min(vs) if self.kind == "min" else max(vs),)
+
+    def host_merge(self, buf_tuples):
+        return self.host_update([b[0] for b in buf_tuples])
+
+    def host_finalize(self, buf):
+        return buf[0]
+
+
+class Max(Min):
+    kind = "max"
+
+
+class Average(AggFunction):
+    """avg: partial buffer = (sum double, count long); result double."""
+
+    @property
+    def buffer_types(self):
+        return (dt.FLOAT64, dt.INT64)
+
+    @property
+    def result_type(self):
+        return dt.FLOAT64
+
+    def update(self, col, gid, capacity, row_index):
+        s, counts = kernels.segment_reduce(
+            col.data.astype(jnp.float64), col.validity, gid, capacity, "sum")
+        return [(s, counts > 0, None),
+                (counts, jnp.ones((capacity,), jnp.bool_), None)]
+
+    def merge(self, bufs, gid, capacity):
+        sb, cb = bufs
+        s, _ = kernels.segment_reduce(sb.data, sb.validity, gid, capacity,
+                                      "sum")
+        c = jax.ops.segment_sum(jnp.where(cb.validity, cb.data, 0), gid,
+                                num_segments=capacity)
+        return [(s, c > 0, None),
+                (c, jnp.ones((capacity,), jnp.bool_), None)]
+
+    def finalize(self, bufs):
+        sb, cb = bufs
+        safe = jnp.where(cb.data > 0, cb.data, 1)
+        return sb.data / safe.astype(jnp.float64), cb.data > 0, None
+
+    def host_update(self, values):
+        vs = [v for v in values if v is not None]
+        if not vs:
+            return (None, 0)
+        return (float(np.sum(np.asarray(vs, np.float64))), len(vs))
+
+    def host_merge(self, buf_tuples):
+        s = [b[0] for b in buf_tuples if b[0] is not None]
+        c = sum(b[1] for b in buf_tuples)
+        return (float(np.sum(s)) if s else None, c)
+
+    def host_finalize(self, buf):
+        s, c = buf
+        return None if c == 0 else s / c
+
+
+class First(AggFunction):
+    """first(x[, ignoreNulls]) — order = arrival order within the partition
+    stream, same determinism caveat as the reference's GpuFirst."""
+
+    pick = "min"
+
+    def __init__(self, child, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def buffer_types(self):
+        return (self.child.data_type(), dt.INT64)
+
+    @property
+    def result_type(self):
+        return self.child.data_type()
+
+    def _gather(self, col: SortedCol, pos, ok):
+        safe = jnp.clip(pos, 0, pos.shape[0] - 1).astype(jnp.int32)
+        val = jnp.take(col.data, safe, axis=0)
+        vval = jnp.take(col.validity, safe, axis=0) & ok
+        if col.lengths is not None:
+            lens = jnp.where(vval, jnp.take(col.lengths, safe, axis=0), 0)
+            val = jnp.where(vval[:, None], val, 0)
+            return val, vval, lens
+        val = jnp.where(vval, val, jnp.zeros_like(val))
+        return val, vval, None
+
+    def update(self, col, gid, capacity, row_index):
+        # Pick by GLOBAL arrival index (monotone across the batch stream, so
+        # first/last stays correct through concat+merge), but gather the
+        # value by sorted position: the stable fingerprint sort preserves
+        # arrival order within a group, so min/max global index coincides
+        # with min/max sorted position.
+        pos = jnp.arange(capacity, dtype=jnp.int64)
+        gidx = pos if row_index is None else row_index.astype(jnp.int64)
+        eligible = col.validity if self.ignore_nulls else \
+            jnp.ones_like(col.validity)
+        bad_pos = jnp.int64(capacity if self.pick == "min" else -1)
+        bad_idx = jnp.int64(2 ** 62 if self.pick == "min" else -1)
+        red = jax.ops.segment_min if self.pick == "min" else \
+            jax.ops.segment_max
+        picked_pos = red(jnp.where(eligible, pos, bad_pos), gid,
+                         num_segments=capacity)
+        picked_idx = red(jnp.where(eligible, gidx, bad_idx), gid,
+                         num_segments=capacity)
+        ok = (picked_pos < capacity) & (picked_pos >= 0)
+        val, vval, lens = self._gather(col, picked_pos, ok)
+        return [(val, vval, lens),
+                (jnp.where(ok, picked_idx, bad_idx), ok, None)]
+
+    def merge(self, bufs, gid, capacity):
+        vcol, icol = bufs
+        bad = jnp.int64(2 ** 62 if self.pick == "min" else -1)
+        keyed = jnp.where(icol.validity, icol.data, bad)
+        red = jax.ops.segment_min if self.pick == "min" else \
+            jax.ops.segment_max
+        picked_val = red(keyed, gid, num_segments=capacity)
+        # Winner = the row holding the reduced index; tie-break by min row.
+        row = jnp.arange(capacity, dtype=jnp.int64)
+        winner = keyed == jnp.take(picked_val, gid, axis=0)
+        wrow = jnp.where(winner & icol.validity, row, capacity)
+        first_row = jax.ops.segment_min(wrow, gid, num_segments=capacity)
+        ok = first_row < capacity
+        val, vval, lens = self._gather(vcol, first_row, ok)
+        iv = jnp.take(icol.data, jnp.clip(first_row, 0, capacity - 1)
+                      .astype(jnp.int32), axis=0)
+        return [(val, vval, lens), (jnp.where(ok, iv, bad), ok, None)]
+
+    def finalize(self, bufs):
+        vcol, _ = bufs
+        return vcol.data, vcol.validity, vcol.lengths
+
+    def host_update(self, values):
+        seq = [(i, v) for i, v in enumerate(values)
+               if not (self.ignore_nulls and v is None)]
+        if not seq:
+            return (None, None)
+        i, v = seq[0] if self.pick == "min" else seq[-1]
+        return (v, i)
+
+    def host_merge(self, buf_tuples):
+        cands = [b for b in buf_tuples if b[1] is not None]
+        if not cands:
+            return (None, None)
+        pickf = min if self.pick == "min" else max
+        return pickf(cands, key=lambda b: b[1])
+
+    def host_finalize(self, buf):
+        return buf[0]
+
+
+class Last(First):
+    pick = "max"
+
+
+@dataclasses.dataclass
+class AggSpec:
+    """A named aggregate in the output (result column)."""
+
+    name: str
+    fn: AggFunction
+
+
+# ---------------------------------------------------------------------------
+# The exec
+# ---------------------------------------------------------------------------
+
+class HashAggregateExec(Exec):
+    """Groupby aggregate. ``mode``:
+    - 'partial': emits [keys..., buffers...] for a downstream exchange
+    - 'final': consumes partial buffers, emits finalized results
+    - 'complete': update+merge+finalize in one node (single-stage plans)
+    """
+
+    def __init__(self, child: Exec,
+                 group_by: Sequence[Tuple[str, Expression]],
+                 aggregates: Sequence[AggSpec],
+                 mode: str = "complete",
+                 merge_threshold_rows: int = 1 << 20):
+        super().__init__(child)
+        assert mode in ("partial", "final", "complete")
+        self.group_names = tuple(n for n, _ in group_by)
+        self.group_exprs = [e for _, e in group_by]
+        self.aggs = list(aggregates)
+        self.mode = mode
+        self.merge_threshold_rows = merge_threshold_rows
+
+    # -- schemas -------------------------------------------------------------
+    @property
+    def buffer_schema(self) -> Schema:
+        cols: List[Tuple[str, dt.DataType]] = []
+        for n, e in zip(self.group_names, self.group_exprs):
+            cols.append((n, e.data_type()))
+        for spec in self.aggs:
+            for bi, bt in enumerate(spec.fn.buffer_types):
+                cols.append((f"{spec.name}#buf{bi}", bt))
+        return tuple(cols)
+
+    @property
+    def schema(self) -> Schema:
+        if self.mode == "partial":
+            return self.buffer_schema
+        cols = [(n, e.data_type())
+                for n, e in zip(self.group_names, self.group_exprs)]
+        cols += [(s.name, s.fn.result_type) for s in self.aggs]
+        return tuple(cols)
+
+    @property
+    def _nkeys(self) -> int:
+        return len(self.group_exprs)
+
+    # -- device path ---------------------------------------------------------
+    def _project_inputs(self, batch: DeviceBatch) -> Tuple[DeviceBatch, list]:
+        """[keys..., agg inputs...] working batch + per-agg input ordinal."""
+        cols = [as_device_column(e.eval(batch), batch)
+                for e in self.group_exprs]
+        ords = []
+        for spec in self.aggs:
+            if spec.fn.child is None:   # count(*)
+                ords.append(None)
+            else:
+                cols.append(as_device_column(spec.fn.child.eval(batch),
+                                             batch))
+                ords.append(len(cols) - 1)
+        return DeviceBatch(tuple(cols), batch.num_rows), ords
+
+    @staticmethod
+    def _sorted_col(col: DeviceColumn, perm, slive) -> SortedCol:
+        data = jnp.take(col.data, perm, axis=0)
+        validity = jnp.take(col.validity, perm, axis=0) & slive
+        lens = None
+        if col.dtype.is_string:
+            lens = jnp.where(validity, jnp.take(col.lengths, perm, axis=0),
+                             0)
+        return SortedCol(data, validity, lens)
+
+    @staticmethod
+    def _buf_column(buf: Buf, bt: dt.DataType, gmask) -> DeviceColumn:
+        data, valid, lens = buf
+        valid = valid & gmask
+        if bt.is_string:
+            data = jnp.where(valid[:, None], data.astype(jnp.uint8), 0)
+            lens = jnp.where(valid, lens, 0)
+            return DeviceColumn(bt, data, valid, lens)
+        data = jnp.where(valid, data.astype(bt.np_dtype),
+                         jnp.zeros((), bt.np_dtype))
+        return DeviceColumn(bt, data, valid)
+
+    def _update_batch(self, batch: DeviceBatch,
+                      offset: jnp.ndarray) -> DeviceBatch:
+        """One input batch -> partial buffer batch. ``offset`` is the global
+        arrival index of this batch's row 0 (orders First/Last across the
+        stream)."""
+        work, ords = self._project_inputs(batch)
+        cap = work.capacity
+        g = kernels.group_ids(work, range(self._nkeys))
+        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
+        row_index = offset.astype(jnp.int64) + g.perm.astype(jnp.int64)
+        out_cols: List[DeviceColumn] = []
+        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
+        for ki in range(self._nkeys):
+            out_cols.append(work.columns[ki].gather(g.group_leader, gmask))
+        for spec, ord_ in zip(self.aggs, ords):
+            if ord_ is None:
+                col = SortedCol(jnp.zeros((cap,), jnp.int64), slive)
+            else:
+                col = self._sorted_col(work.columns[ord_], g.perm, slive)
+            bufs = spec.fn.update(col, g.group_of_sorted, cap, row_index)
+            for buf, bt in zip(bufs, spec.fn.buffer_types):
+                out_cols.append(self._buf_column(buf, bt, gmask))
+        return DeviceBatch(tuple(out_cols), g.num_groups)
+
+    def _merge_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        """Merge a buffer batch (re-group by keys, merge buffers)."""
+        cap = batch.capacity
+        g = kernels.group_ids(batch, range(self._nkeys))
+        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
+        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
+        out_cols: List[DeviceColumn] = []
+        for ki in range(self._nkeys):
+            out_cols.append(batch.columns[ki].gather(g.group_leader, gmask))
+        ci = self._nkeys
+        for spec in self.aggs:
+            nbuf = len(spec.fn.buffer_types)
+            bufs = [self._sorted_col(batch.columns[ci + b], g.perm, slive)
+                    for b in range(nbuf)]
+            merged = spec.fn.merge(bufs, g.group_of_sorted, cap)
+            for buf, bt in zip(merged, spec.fn.buffer_types):
+                out_cols.append(self._buf_column(buf, bt, gmask))
+            ci += nbuf
+        return DeviceBatch(tuple(out_cols), g.num_groups)
+
+    def _finalize_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        out_cols = list(batch.columns[:self._nkeys])
+        ci = self._nkeys
+        gmask = batch.row_mask()
+        for spec in self.aggs:
+            nbuf = len(spec.fn.buffer_types)
+            bufs = [SortedCol(batch.columns[ci + b].data,
+                              batch.columns[ci + b].validity,
+                              batch.columns[ci + b].lengths)
+                    for b in range(nbuf)]
+            data, valid, lens = spec.fn.finalize(bufs)
+            out_cols.append(self._buf_column((data, valid, lens),
+                                             spec.fn.result_type, gmask))
+            ci += nbuf
+        return DeviceBatch(tuple(out_cols), batch.num_rows)
+
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        update = jax.jit(self._update_batch)
+        merge = jax.jit(self._merge_batch)
+        finalize = jax.jit(self._finalize_batch)
+
+        acc: Optional[DeviceBatch] = None
+        saw_input = False
+        offset = 0
+        for batch in self.children[0].execute_device(ctx, partition):
+            saw_input = True
+            with timed(m):
+                # 'final' consumes buffer batches: first pass is a merge.
+                partial = merge(batch) if self.mode == "final" \
+                    else update(batch, jnp.asarray(offset, jnp.int64))
+                offset += batch.capacity
+                if acc is None:
+                    acc = partial
+                else:
+                    cap = bucket_capacity(acc.capacity + partial.capacity)
+                    acc = concat_batches([acc, partial], cap)
+                    if acc.capacity >= self.merge_threshold_rows:
+                        acc = merge(acc)
+        if not saw_input or acc is None:
+            if self._nkeys == 0 and self.mode in ("final", "complete"):
+                yield self._empty_result()
+            return
+        with timed(m):
+            acc = merge(acc)
+            if self.mode in ("final", "complete"):
+                acc = finalize(acc)
+        m.add("numOutputBatches", 1)
+        yield acc
+
+    def _empty_result(self) -> DeviceBatch:
+        cap = 8
+        cols = []
+        for spec in self.aggs:
+            t = spec.fn.result_type
+            if isinstance(spec.fn, (Count, CountStar)):
+                data = jnp.zeros((cap,), t.np_dtype)
+                valid = jnp.arange(cap) < 1
+            else:
+                data = jnp.zeros((cap,), t.np_dtype)
+                valid = jnp.zeros((cap,), jnp.bool_)
+            if t.is_string:
+                cols.append(DeviceColumn(t, jnp.zeros((cap, 8), jnp.uint8),
+                                         valid, jnp.zeros((cap,), jnp.int32)))
+            else:
+                cols.append(DeviceColumn(t, data, valid))
+        return DeviceBatch(tuple(cols), jnp.asarray(1, jnp.int32))
+
+    # -- host oracle ---------------------------------------------------------
+    def _host_groups(self, hbs, key_evaluator, input_lists):
+        """Shared host grouping: returns (order, key_values, groups) where
+        groups[key][ai] is the list of python values for aggregate ai."""
+        groups = {}
+        key_values = {}
+        order = []
+        for hb, keycols, inlists in zip(hbs, key_evaluator, input_lists):
+            for i in range(hb.num_rows):
+                triples = [self._host_key(kc, i) for kc in keycols]
+                # Canonical key only — raw floats break NaN equality.
+                key = tuple((t[0], t[1]) for t in triples)
+                if key not in groups:
+                    groups[key] = [[] for _ in self.aggs]
+                    key_values[key] = [t[2] if t[0] else None
+                                       for t in triples]
+                    order.append(key)
+                for ai, vals in enumerate(inlists):
+                    groups[key][ai].append(vals[i] if vals is not None
+                                           else 1)
+        return order, key_values, groups
+
+    def execute_host(self, ctx, partition):
+        hbs = list(self.children[0].execute_host(ctx, partition))
+        if self.mode == "final":
+            yield from self._execute_host_final(hbs)
+            return
+        key_evaluator = []
+        input_lists = []
+        for hb in hbs:
+            key_evaluator.append([as_host_column(e.eval_host(hb), hb)
+                                  for e in self.group_exprs])
+            inlists = []
+            for spec in self.aggs:
+                if spec.fn.child is None:
+                    inlists.append(None)
+                else:
+                    inlists.append(as_host_column(
+                        spec.fn.child.eval_host(hb), hb).to_list())
+            input_lists.append(inlists)
+        order, key_values, groups = self._host_groups(hbs, key_evaluator,
+                                                      input_lists)
+        rows: List[tuple] = []
+        for key in order:
+            vals = list(key_values[key])
+            for ai, spec in enumerate(self.aggs):
+                if self.mode == "partial":
+                    vals.extend(spec.fn.host_update(groups[key][ai]))
+                else:
+                    vals.append(spec.fn.host_agg(groups[key][ai]))
+            rows.append(tuple(vals))
+        if not rows and self._nkeys == 0:
+            vals = []
+            for spec in self.aggs:
+                if self.mode == "partial":
+                    vals.extend(spec.fn.host_update([]))
+                else:
+                    vals.append(spec.fn.host_agg([]))
+            rows = [tuple(vals)]
+        yield _rows_to_host_batch(rows, self.schema)
+
+    def _execute_host_final(self, hbs):
+        """Host final mode: group buffer rows by key, merge buffer tuples."""
+        key_evaluator = []
+        buf_lists = []
+        for hb in hbs:
+            key_evaluator.append(list(hb.columns[:self._nkeys]))
+            # One pseudo-input per aggregate: the tuple of its buffer values.
+            ci = self._nkeys
+            per_agg = []
+            for spec in self.aggs:
+                nbuf = len(spec.fn.buffer_types)
+                cols = [hb.columns[ci + b].to_list() for b in range(nbuf)]
+                per_agg.append(list(zip(*cols)) if cols else [])
+                ci += nbuf
+            buf_lists.append(per_agg)
+        order, key_values, groups = self._host_groups(hbs, key_evaluator,
+                                                      buf_lists)
+        rows = []
+        for key in order:
+            vals = list(key_values[key])
+            for ai, spec in enumerate(self.aggs):
+                merged = spec.fn.host_merge(groups[key][ai])
+                vals.append(spec.fn.host_finalize(merged))
+            rows.append(tuple(vals))
+        yield _rows_to_host_batch(rows, self.schema)
+
+    @staticmethod
+    def _host_key(col: HostColumn, i: int):
+        """(valid, canonical-group-key, output-value) triple for one key."""
+        if not col.validity[i]:
+            return (False, None, None)
+        v = col.data[i]
+        if col.dtype.is_string:
+            s = bytes(v).decode("utf-8", "replace")
+            return (True, s, s)
+        if col.dtype.is_floating:
+            f = float(v)
+            if np.isnan(f):
+                return (True, "NaN", f)   # NaN == NaN for grouping
+            if f == 0.0:
+                return (True, 0.0, 0.0)   # -0.0 == 0.0 for grouping
+            return (True, f, f)
+        if col.dtype.is_boolean:
+            return (True, bool(v), bool(v))
+        return (True, int(v), int(v))
+
+
+def _rows_to_host_batch(rows: List[tuple], schema: Schema) -> HostBatch:
+    names = tuple(n for n, _ in schema)
+    cols = []
+    for ci, (_, t) in enumerate(schema):
+        vals = [r[ci] for r in rows]
+        cols.append(HostColumn.from_values(t, vals))
+    return HostBatch(names, cols)

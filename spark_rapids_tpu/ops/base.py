@@ -1,0 +1,192 @@
+"""Physical operator base (ref: GpuExec.scala:65).
+
+Execution model: a plan is a tree of ``Exec`` nodes; each node, per
+partition, produces an iterator of batches. Two engines exist, mirroring the
+reference's CPU-Spark vs GPU split:
+
+- device: iterators of ``DeviceBatch``; per-batch kernels are pure jnp
+  functions (jittable). The Python generator layer is only orchestration —
+  the same division the reference has between JVM iterators and cuDF kernels.
+- host: iterators of ``HostBatch`` (numpy) — the CPU fallback engine and the
+  comparison oracle.
+
+Metrics mirror GpuMetricNames (GpuExec.scala:27-56): numOutputRows,
+numOutputBatches, totalTime (ns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.host import (
+    HostBatch, device_to_host, host_to_device)
+from spark_rapids_tpu.config import TpuConf
+
+Schema = Tuple[Tuple[str, DataType], ...]
+
+
+class Metrics:
+    """Per-operator metric registry (NvtxWithMetrics analog, minus NVTX —
+    the tracing module attaches jax.profiler ranges instead)."""
+
+    def __init__(self):
+        self.values: Dict[str, float] = {}
+
+    def add(self, name: str, amount: float):
+        self.values[name] = self.values.get(name, 0) + amount
+
+    def __repr__(self):  # pragma: no cover - cosmetic
+        return f"Metrics({self.values})"
+
+
+@dataclasses.dataclass
+class ExecContext:
+    """Per-query execution context: conf + metrics sink."""
+
+    conf: TpuConf = dataclasses.field(default_factory=TpuConf)
+    metrics: Dict[str, Metrics] = dataclasses.field(default_factory=dict)
+
+    def metrics_for(self, op: "Exec") -> Metrics:
+        key = f"{type(op).__name__}@{id(op):x}"
+        if key not in self.metrics:
+            self.metrics[key] = Metrics()
+        return self.metrics[key]
+
+
+class Exec:
+    """A physical operator. Subclasses implement the per-partition device
+    and host paths. ``schema`` is the output schema."""
+
+    def __init__(self, *children: "Exec"):
+        self.children: Tuple["Exec", ...] = tuple(children)
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    # Number of output partitions (defaults to the first child's).
+    def num_partitions(self, ctx: ExecContext) -> int:
+        return self.children[0].num_partitions(ctx)
+
+    # -- device engine -------------------------------------------------------
+    def execute_device(self, ctx: ExecContext,
+                       partition: int) -> Iterator[DeviceBatch]:
+        raise NotImplementedError
+
+    # -- host engine ---------------------------------------------------------
+    def execute_host(self, ctx: ExecContext,
+                     partition: int) -> Iterator[HostBatch]:
+        raise NotImplementedError
+
+    # -- helpers -------------------------------------------------------------
+    def collect(self, ctx: Optional[ExecContext] = None,
+                device: bool = True) -> List[tuple]:
+        """Run all partitions and collect rows (driver collect analog)."""
+        ctx = ctx or ExecContext()
+        rows: List[tuple] = []
+        names = tuple(n for n, _ in self.schema)
+        for p in range(self.num_partitions(ctx)):
+            if device:
+                for b in self.execute_device(ctx, p):
+                    rows.extend(device_to_host(b, names).to_pylist())
+            else:
+                for b in self.execute_host(ctx, p):
+                    rows.extend(b.to_pylist())
+        return rows
+
+    def pretty_tree(self, indent: int = 0) -> str:
+        out = "  " * indent + self.name + "\n"
+        for c in self.children:
+            out += c.pretty_tree(indent + 1)
+        return out
+
+
+class LeafExec(Exec):
+    """Base for source nodes (scans, in-memory sources)."""
+
+    def num_partitions(self, ctx: ExecContext) -> int:
+        raise NotImplementedError
+
+
+class InMemorySourceExec(LeafExec):
+    """In-memory host-batch source, pre-partitioned (test/bench currency;
+    the DataFrame frontend's createDataFrame lands here)."""
+
+    def __init__(self, schema: Schema,
+                 partitions: Sequence[Sequence[HostBatch]]):
+        super().__init__()
+        self._schema = tuple(schema)
+        self._partitions = [list(p) for p in partitions]
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecContext) -> int:
+        return len(self._partitions)
+
+    def execute_device(self, ctx, partition):
+        for hb in self._partitions[partition]:
+            yield host_to_device(hb)
+
+    def execute_host(self, ctx, partition):
+        yield from iter(self._partitions[partition])
+
+
+class DeviceToHostExec(Exec):
+    """Explicit device->host transition (GpuColumnarToRowExec analog): runs
+    the child on the device engine, downloads each batch."""
+
+    def __init__(self, child: Exec):
+        super().__init__(child)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute_host(self, ctx, partition):
+        names = tuple(n for n, _ in self.schema)
+        for b in self.children[0].execute_device(ctx, partition):
+            yield device_to_host(b, names)
+
+    def execute_device(self, ctx, partition):  # pragma: no cover
+        raise AssertionError("DeviceToHostExec is a host-side node")
+
+
+class HostToDeviceExec(Exec):
+    """Explicit host->device transition (GpuRowToColumnarExec analog)."""
+
+    def __init__(self, child: Exec):
+        super().__init__(child)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute_device(self, ctx, partition):
+        for hb in self.children[0].execute_host(ctx, partition):
+            yield host_to_device(hb)
+
+    def execute_host(self, ctx, partition):  # pragma: no cover
+        raise AssertionError("HostToDeviceExec is a device-side node")
+
+
+def timed(metrics: Metrics, name: str = "totalTime"):
+    """Context manager adding elapsed ns to a metric (NvtxWithMetrics.scala
+    analog)."""
+    class _Timer:
+        def __enter__(self):
+            self.t0 = time.perf_counter_ns()
+
+        def __exit__(self, *exc):
+            metrics.add(name, time.perf_counter_ns() - self.t0)
+            return False
+    return _Timer()

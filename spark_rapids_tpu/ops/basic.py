@@ -1,0 +1,291 @@
+"""Basic physical operators (ref: basicPhysicalOperators.scala, limit.scala,
+GpuExpandExec.scala).
+
+Project/Filter/Union/Coalesce/Range/Limits/Expand. Per-batch device kernels
+are jitted once per (expression list, batch shape) via jax.jit closure
+caching; the generator layer stays in Python (orchestration only).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import (
+    Expression, as_device_column, as_host_column, eval_exprs,
+    eval_exprs_host)
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+
+
+class ProjectExec(Exec):
+    """Evaluate named expressions per batch (GpuProjectExec,
+    basicPhysicalOperators.scala:66)."""
+
+    def __init__(self, child: Exec,
+                 projections: Sequence[Tuple[str, Expression]]):
+        super().__init__(child)
+        self.names = tuple(n for n, _ in projections)
+        self.exprs = [e for _, e in projections]
+        self._jit = None
+
+    @property
+    def schema(self) -> Schema:
+        return tuple((n, e.data_type())
+                     for n, e in zip(self.names, self.exprs))
+
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        if self._jit is None and all(e.jittable for e in self.exprs):
+            self._jit = jax.jit(lambda b: eval_exprs(self.exprs, b))
+        fn = self._jit or (lambda b: eval_exprs(self.exprs, b))
+        for batch in self.children[0].execute_device(ctx, partition):
+            with timed(m):
+                out = fn(batch)
+            m.add("numOutputBatches", 1)
+            yield out
+
+    def execute_host(self, ctx, partition):
+        for hb in self.children[0].execute_host(ctx, partition):
+            yield eval_exprs_host(self.exprs, hb, self.names)
+
+
+class FilterExec(Exec):
+    """Row filter via compaction (GpuFilterExec; cuDF Table.filter analog —
+    here compact() packs kept rows to the front, keeping capacity static)."""
+
+    def __init__(self, child: Exec, condition: Expression):
+        super().__init__(child)
+        self.condition = condition
+        self._jit = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def _kernel(self, batch: DeviceBatch) -> DeviceBatch:
+        cond = as_device_column(self.condition.eval(batch), batch)
+        keep = cond.data & cond.validity
+        return batch.compact(keep)
+
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        if self._jit is None and self.condition.jittable:
+            self._jit = jax.jit(self._kernel)
+        fn = self._jit or self._kernel
+        for batch in self.children[0].execute_device(ctx, partition):
+            with timed(m):
+                out = fn(batch)
+            m.add("numOutputBatches", 1)
+            yield out
+
+    def execute_host(self, ctx, partition):
+        for hb in self.children[0].execute_host(ctx, partition):
+            cond = as_host_column(self.condition.eval_host(hb), hb)
+            keep = cond.data & cond.validity
+            cols = []
+            for c in hb.columns:
+                cols.append(HostColumn(c.dtype, c.data[keep],
+                                       c.validity[keep]))
+            yield HostBatch(hb.names, cols)
+
+
+class UnionExec(Exec):
+    """Concatenation of children's partitions (GpuUnionExec)."""
+
+    def __init__(self, *children: Exec):
+        super().__init__(*children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self, ctx) -> int:
+        return sum(c.num_partitions(ctx) for c in self.children)
+
+    def _locate(self, ctx, partition: int):
+        for c in self.children:
+            n = c.num_partitions(ctx)
+            if partition < n:
+                return c, partition
+            partition -= n
+        raise IndexError(partition)
+
+    def execute_device(self, ctx, partition):
+        child, p = self._locate(ctx, partition)
+        yield from child.execute_device(ctx, p)
+
+    def execute_host(self, ctx, partition):
+        child, p = self._locate(ctx, partition)
+        yield from child.execute_host(ctx, p)
+
+
+class CoalescePartitionsExec(Exec):
+    """Reduce partition count by concatenating streams (GpuCoalesceExec)."""
+
+    def __init__(self, child: Exec, num_partitions: int = 1):
+        super().__init__(child)
+        self._n = num_partitions
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self, ctx) -> int:
+        return min(self._n, self.children[0].num_partitions(ctx))
+
+    def _sources(self, ctx, partition: int) -> List[int]:
+        child_n = self.children[0].num_partitions(ctx)
+        mine = self.num_partitions(ctx)
+        return [p for p in range(child_n) if p % mine == partition]
+
+    def execute_device(self, ctx, partition):
+        for p in self._sources(ctx, partition):
+            yield from self.children[0].execute_device(ctx, p)
+
+    def execute_host(self, ctx, partition):
+        for p in self._sources(ctx, partition):
+            yield from self.children[0].execute_host(ctx, p)
+
+
+class RangeExec(Exec):
+    """range(start, end, step) source (GpuRangeExec,
+    basicPhysicalOperators.scala:190)."""
+
+    def __init__(self, start: int, end: int, step: int = 1,
+                 num_partitions: int = 1, batch_rows: int = 1 << 20,
+                 name: str = "id"):
+        super().__init__()
+        assert step != 0
+        self.start, self.end, self.step = start, end, step
+        self._parts = num_partitions
+        self.batch_rows = batch_rows
+        self._name = name
+
+    @property
+    def schema(self) -> Schema:
+        return ((self._name, dt.INT64),)
+
+    def num_partitions(self, ctx) -> int:
+        return self._parts
+
+    def _bounds(self, partition: int) -> Tuple[int, int]:
+        total = max(0, -(-(self.end - self.start) // self.step))
+        per = -(-total // self._parts)
+        lo = min(per * partition, total)
+        hi = min(lo + per, total)
+        return lo, hi
+
+    def execute_device(self, ctx, partition):
+        lo, hi = self._bounds(partition)
+        cap = bucket_capacity(min(self.batch_rows, max(hi - lo, 1)))
+        idx = lo
+        while idx < hi:
+            n = min(cap, hi - idx)
+            base = self.start + idx * self.step
+            data = base + jnp.arange(cap, dtype=jnp.int64) * self.step
+            validity = jnp.arange(cap, dtype=jnp.int32) < n
+            data = jnp.where(validity, data, 0)
+            col = DeviceColumn(dt.INT64, data, validity)
+            yield DeviceBatch((col,), jnp.asarray(n, jnp.int32))
+            idx += n
+
+    def execute_host(self, ctx, partition):
+        lo, hi = self._bounds(partition)
+        idx = lo
+        while idx < hi:
+            n = min(self.batch_rows, hi - idx)
+            base = self.start + idx * self.step
+            data = base + np.arange(n, dtype=np.int64) * self.step
+            col = HostColumn(dt.INT64, data, np.ones(n, np.bool_))
+            yield HostBatch((self._name,), [col])
+            idx += n
+
+
+class LocalLimitExec(Exec):
+    """Per-partition head(n) (GpuLocalLimitExec, limit.scala)."""
+
+    def __init__(self, child: Exec, limit: int):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute_device(self, ctx, partition):
+        remaining = self.limit
+        for batch in self.children[0].execute_device(ctx, partition):
+            if remaining <= 0:
+                break
+            out = batch.head(remaining)
+            # num_rows is a device scalar; pull it once per batch to advance
+            # the python-side budget (same sync the reference does for limits)
+            taken = int(out.num_rows)
+            remaining -= taken
+            yield out
+
+    def execute_host(self, ctx, partition):
+        remaining = self.limit
+        for hb in self.children[0].execute_host(ctx, partition):
+            if remaining <= 0:
+                break
+            n = min(remaining, hb.num_rows)
+            cols = [HostColumn(c.dtype, c.data[:n], c.validity[:n])
+                    for c in hb.columns]
+            remaining -= n
+            yield HostBatch(hb.names, cols)
+
+
+class GlobalLimitExec(Exec):
+    """Single-partition global limit; expects a 1-partition child
+    (GpuGlobalLimitExec)."""
+
+    def __init__(self, child: Exec, limit: int):
+        super().__init__(child)
+        self.limit = limit
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute_device(self, ctx, partition):
+        inner = LocalLimitExec(self.children[0], self.limit)
+        yield from inner.execute_device(ctx, partition)
+
+    def execute_host(self, ctx, partition):
+        inner = LocalLimitExec(self.children[0], self.limit)
+        yield from inner.execute_host(ctx, partition)
+
+
+class ExpandExec(Exec):
+    """GROUPING SETS expansion (GpuExpandExec.scala): each input row is
+    emitted once per projection list."""
+
+    def __init__(self, child: Exec,
+                 projections: Sequence[Sequence[Expression]],
+                 names: Sequence[str]):
+        super().__init__(child)
+        self.projections = [list(p) for p in projections]
+        self.names = tuple(names)
+
+    @property
+    def schema(self) -> Schema:
+        return tuple((n, e.data_type())
+                     for n, e in zip(self.names, self.projections[0]))
+
+    def execute_device(self, ctx, partition):
+        for batch in self.children[0].execute_device(ctx, partition):
+            for proj in self.projections:
+                yield eval_exprs(proj, batch)
+
+    def execute_host(self, ctx, partition):
+        for hb in self.children[0].execute_host(ctx, partition):
+            for proj in self.projections:
+                yield eval_exprs_host(proj, hb, self.names)

@@ -1,0 +1,313 @@
+"""Shared device kernels: key normalization, lexicographic sort, grouping.
+
+These are the TPU-first replacements for the cuDF kernels the reference
+reaches through JNI (Table.orderBy, Table.groupBy, hash partition): everything
+is expressed as stable argsorts, segmented reductions and scatters over
+fixed-capacity arrays, so XLA can fuse and tile them (no dynamic allocations,
+no data-dependent shapes — SURVEY.md §7 "hard parts" #1/#3).
+
+Key ideas:
+- ``sort_key_passes`` turns any key column into a list of uint32 radix words,
+  most-significant first, already adjusted for asc/desc and null ordering.
+  A multi-column sort is then a sequence of stable argsorts over the reversed
+  pass list (LSD radix over words).
+- ``group_ids`` gives each live row a dense group index by sorting rows by a
+  128-bit key fingerprint (two independent murmur3 streams + null pattern);
+  equal keys become adjacent, segment boundaries fall where the fingerprint
+  changes. Collision probability is ~n^2/2^64 per batch — the same class of
+  trade cuDF's hash aggregation makes.
+- ``segment_reduce`` wraps jax.ops.segment_* with null discipline (Spark
+  semantics: aggregates skip nulls; all-null groups yield null).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.exprs import hash as mh
+
+
+# ---------------------------------------------------------------------------
+# Orderable key normalization
+# ---------------------------------------------------------------------------
+
+def _orderable_u32_words(col: DeviceColumn) -> List[jnp.ndarray]:
+    """Column -> list of uint32 words, most-significant first, such that
+    lexicographic unsigned comparison of the word tuple == SQL ordering
+    (ascending, nulls handled separately)."""
+    t = col.dtype
+    if t.is_string:
+        # Bytes are already unsigned-lexicographic; zero padding sorts
+        # shorter strings first, matching SQL byte ordering (strings with
+        # embedded NUL bytes are the known approximation).
+        data = col.data
+        w = data.shape[1]
+        words = []
+        for i in range(0, w, 4):
+            chunk = data[:, i:i + 4]
+            if chunk.shape[1] < 4:
+                pad = jnp.zeros((data.shape[0], 4 - chunk.shape[1]),
+                                jnp.uint8)
+                chunk = jnp.concatenate([chunk, pad], axis=1)
+            word = (chunk[:, 0].astype(jnp.uint32) << 24) | \
+                   (chunk[:, 1].astype(jnp.uint32) << 16) | \
+                   (chunk[:, 2].astype(jnp.uint32) << 8) | \
+                   chunk[:, 3].astype(jnp.uint32)
+            words.append(word)
+        return words
+    if t.is_floating:
+        if t.name == "float32":
+            bits = jnp.asarray(col.data, jnp.float32).view(jnp.uint32)
+            # IEEE total order: flip all bits if negative else flip sign.
+            neg = (bits >> jnp.uint32(31)) == 1
+            bits = jnp.where(neg, ~bits, bits | jnp.uint32(0x80000000))
+            # Spark: NaN sorts greater than everything; canonical NaN bits
+            # already sort above +inf after the transform.
+            return [bits]
+        bits = jnp.asarray(col.data, jnp.float64).view(jnp.uint64)
+        neg = (bits >> jnp.uint64(63)) == 1
+        bits = jnp.where(neg, ~bits, bits | jnp.uint64(0x8000000000000000))
+        return [(bits >> jnp.uint64(32)).astype(jnp.uint32),
+                (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]
+    if t.name in ("int64", "timestamp"):
+        u = col.data.astype(jnp.int64).astype(jnp.uint64) ^ \
+            jnp.uint64(0x8000000000000000)
+        return [(u >> jnp.uint64(32)).astype(jnp.uint32),
+                (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]
+    # bool/int8/16/32/date -> one word, sign-bias flip.
+    u = col.data.astype(jnp.int32).astype(jnp.uint32) ^ jnp.uint32(0x80000000)
+    return [u]
+
+
+def sort_key_passes(col: DeviceColumn, ascending: bool,
+                    nulls_first: bool) -> List[jnp.ndarray]:
+    """Radix word passes for one sort key, MSW first, including the null
+    ordering word. Descending keys get bit-flipped words."""
+    words = _orderable_u32_words(col)
+    if not ascending:
+        words = [~w for w in words]
+    # Null word: 0 sorts first. nulls_first -> nulls get 0, else 1-flip.
+    if nulls_first:
+        null_word = jnp.where(col.validity, jnp.uint32(1), jnp.uint32(0))
+    else:
+        null_word = jnp.where(col.validity, jnp.uint32(0), jnp.uint32(1))
+    # Zero data words for nulls so null ordering is decided by null_word.
+    words = [jnp.where(col.validity, w, jnp.uint32(0)) for w in words]
+    return [null_word] + words
+
+
+def lex_sort_perm(passes: List[jnp.ndarray], num_rows: jnp.ndarray,
+                  capacity: int) -> jnp.ndarray:
+    """Stable permutation sorting rows by the MSW-first word passes; padding
+    rows always sort last."""
+    pad_last = jnp.where(
+        jnp.arange(capacity, dtype=jnp.int32) < num_rows,
+        jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
+    perm = jnp.arange(capacity, dtype=jnp.int32)
+    # LSD radix over words: apply stable argsort from least significant pass
+    # to most significant; padding pass last (most significant of all).
+    for words in reversed(passes):
+        keyed = jnp.take(words, perm, axis=0)
+        order = jnp.argsort(keyed, stable=True)
+        perm = jnp.take(perm, order, axis=0)
+    keyed = jnp.take(pad_last, perm, axis=0)
+    order = jnp.argsort(keyed, stable=True)
+    return jnp.take(perm, order, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Grouping
+# ---------------------------------------------------------------------------
+
+_SEED_A = 42
+_SEED_B = 0x5EED
+
+
+def key_fingerprint(cols: Sequence[DeviceColumn],
+                    capacity: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 32-bit fingerprints of the key tuple per row.
+
+    Null rows must differ from any value: the null pattern is mixed into the
+    second stream explicitly (murmur3 passes the seed through on null, which
+    would otherwise let NULL collide with unlucky values)."""
+    ha = jnp.full((capacity,), np.uint32(_SEED_A), dtype=jnp.uint32)
+    hb = jnp.full((capacity,), np.uint32(_SEED_B), dtype=jnp.uint32)
+    for i, c in enumerate(cols):
+        if c.dtype.is_floating:
+            # Grouping equality: -0.0 == 0.0 and NaN == NaN (Spark inserts
+            # NormalizeNaNAndZero before grouping; we fold it in here).
+            data = jnp.where(c.data == 0, jnp.zeros_like(c.data), c.data)
+            c = DeviceColumn(c.dtype, data, c.validity)
+        ha = mh.hash_column(jnp, c, c.dtype, ha)
+        hb = mh.hash_column(jnp, c, c.dtype, hb)
+        # Mix null flag into stream B so NULL != seed-collision value.
+        nullbit = jnp.where(c.validity, jnp.uint32(0),
+                            jnp.uint32(0x9E3779B9 + i))
+        hb = mh._fmix(jnp, hb ^ nullbit, 4)
+    return ha, hb
+
+
+@dataclasses.dataclass
+class Grouping:
+    """Result of group_ids: rows sorted so equal keys are adjacent."""
+
+    perm: jnp.ndarray         # (capacity,) row permutation (padding last)
+    group_of_sorted: jnp.ndarray  # (capacity,) dense group id per sorted row
+    num_groups: jnp.ndarray   # int32 scalar
+    group_leader: jnp.ndarray  # (capacity,) original row index of each
+    #                            group's first sorted row (by group id)
+
+
+def group_ids(batch: DeviceBatch, key_ordinals: Sequence[int]) -> Grouping:
+    """Assign dense group ids over the key columns (cuDF groupBy analog)."""
+    cap = batch.capacity
+    cols = [batch.columns[i] for i in key_ordinals]
+    ha, hb = key_fingerprint(cols, cap)
+    live = batch.row_mask()
+    # Sort rows by (live desc, ha, hb): padding last.
+    passes = [jnp.where(live, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), ha, hb]
+    perm = jnp.arange(cap, dtype=jnp.int32)
+    for words in reversed(passes):
+        keyed = jnp.take(words, perm, axis=0)
+        order = jnp.argsort(keyed, stable=True)
+        perm = jnp.take(perm, order, axis=0)
+    sa = jnp.take(ha, perm, axis=0)
+    sb = jnp.take(hb, perm, axis=0)
+    slive = jnp.take(live, perm, axis=0)
+    prev_a = jnp.concatenate([sa[:1] ^ jnp.uint32(1), sa[:-1]])
+    prev_b = jnp.concatenate([sb[:1], sb[:-1]])
+    new_seg = ((sa != prev_a) | (sb != prev_b)) & slive
+    # First live sorted row always starts a segment.
+    first_live = jnp.argmax(slive.astype(jnp.int32))
+    new_seg = new_seg | (jnp.arange(cap) == first_live) & slive
+    gid = jnp.cumsum(new_seg.astype(jnp.int32)) - 1
+    # Padding rows go to the last slot (their writes are masked downstream).
+    gid = jnp.where(slive, gid, jnp.int32(max(cap - 1, 0)))
+    num_groups = jnp.sum(new_seg.astype(jnp.int32))
+    # Leader: original row index of each group's first sorted row.
+    leader = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(new_seg, gid, cap)].set(perm, mode="drop")
+    return Grouping(perm, gid, num_groups, leader)
+
+
+def segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,
+                   gid: jnp.ndarray, capacity: int, kind: str,
+                   count_also: bool = False):
+    """Segmented aggregate with Spark null discipline.
+
+    values/validity are already permuted to sorted order; gid is
+    group_of_sorted. Returns (agg (capacity,), non_null_count (capacity,)).
+    ``kind``: sum | min | max.
+    """
+    if kind == "sum":
+        masked = jnp.where(validity, values,
+                           jnp.zeros_like(values))
+        agg = jax.ops.segment_sum(masked, gid, num_segments=capacity)
+    elif kind in ("min", "max"):
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            # Reduce in the IEEE total-order uint domain so NaN behaves as
+            # the greatest value (Spark ordering) instead of propagating.
+            bits, inv = _float_orderable(values)
+            fill = jnp.asarray(
+                jnp.iinfo(bits.dtype).max if kind == "min" else 0,
+                bits.dtype)
+            masked = jnp.where(validity, bits, fill)
+            red = jax.ops.segment_min if kind == "min" else \
+                jax.ops.segment_max
+            agg = inv(red(masked, gid, num_segments=capacity))
+        else:
+            masked = jnp.where(validity, values,
+                               _identity_for(values.dtype, kind))
+            red = jax.ops.segment_min if kind == "min" else \
+                jax.ops.segment_max
+            agg = red(masked, gid, num_segments=capacity)
+    else:
+        raise ValueError(kind)
+    counts = jax.ops.segment_sum(validity.astype(jnp.int64), gid,
+                                 num_segments=capacity)
+    return agg, counts
+
+
+def segment_minmax_string(data: jnp.ndarray, lengths: jnp.ndarray,
+                          validity: jnp.ndarray, gid: jnp.ndarray,
+                          capacity: int, want_max: bool):
+    """Per-group lexicographic min/max of a string column.
+
+    Inputs are in group-sorted order (groups adjacent). Strategy: one more
+    stable radix sort keyed by [gid, null-loses, value words] — after it the
+    first row of each gid run is the winner. Returns a (data, validity,
+    lengths) buffer triple indexed by group id.
+    """
+    col = DeviceColumn(dt.STRING, data, validity, lengths)
+    words = _orderable_u32_words(col)
+    if want_max:
+        words = [~w for w in words]
+        # Max must also prefer longer strings on equal prefix: flip the
+        # length tiebreak too (zero padding already makes shorter sort
+        # first ascending; flipping words flips prefix order but not the
+        # implicit length order, so add an explicit length word).
+        lenword = ~lengths.astype(jnp.uint32)
+    else:
+        lenword = lengths.astype(jnp.uint32)
+    loser = jnp.where(validity, jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
+    words = [jnp.where(validity, w, jnp.uint32(0)) for w in words]
+    lenword = jnp.where(validity, lenword, jnp.uint32(0))
+    passes = [gid.astype(jnp.uint32), loser] + words + [lenword]
+    perm = jnp.arange(capacity, dtype=jnp.int32)
+    for w in reversed(passes):
+        keyed = jnp.take(w, perm, axis=0)
+        order = jnp.argsort(keyed, stable=True)
+        perm = jnp.take(perm, order, axis=0)
+    sorted_gid = jnp.take(gid, perm, axis=0)
+    prev = jnp.concatenate([sorted_gid[:1] ^ 1, sorted_gid[:-1]])
+    new_seg = sorted_gid != prev
+    new_seg = new_seg | (jnp.arange(capacity) == 0)
+    winner = jnp.zeros((capacity,), jnp.int32).at[
+        jnp.where(new_seg, sorted_gid, capacity)].set(perm, mode="drop")
+    has_valid = jax.ops.segment_sum(validity.astype(jnp.int32), gid,
+                                    num_segments=capacity) > 0
+    out_data = jnp.take(data, winner, axis=0)
+    out_lens = jnp.take(lengths, winner, axis=0)
+    out_data = jnp.where(has_valid[:, None], out_data, 0)
+    out_lens = jnp.where(has_valid, out_lens, 0)
+    return out_data, has_valid, out_lens
+
+
+def _float_orderable(values: jnp.ndarray):
+    """Map floats to order-preserving unsigned ints; returns (bits, inverse).
+
+    NaN's canonical bit pattern lands above +inf, matching Spark's
+    NaN-is-greatest ordering."""
+    if values.dtype == jnp.float32:
+        u, sign = jnp.uint32, jnp.uint32(0x80000000)
+        bits = values.view(jnp.uint32)
+        shift = jnp.uint32(31)
+    else:
+        u, sign = jnp.uint64, jnp.uint64(0x8000000000000000)
+        bits = values.view(jnp.uint64)
+        shift = jnp.uint64(63)
+    neg = (bits >> shift) == 1
+    fwd = jnp.where(neg, ~bits, bits | sign)
+
+    def inverse(b):
+        was_pos = (b & sign) != 0
+        orig = jnp.where(was_pos, b & ~sign, ~b)
+        return orig.view(values.dtype)
+
+    return fwd, inverse
+
+
+def _identity_for(dtype, kind: str):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf if kind == "min" else -jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(kind == "min", dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if kind == "min" else info.min, dtype)

@@ -1,0 +1,148 @@
+"""Sort operator (ref: GpuSortExec.scala + SortUtils.scala).
+
+Full sort requires the whole partition as one batch (same RequireSingleBatch
+restriction the reference has in v0.3); the device kernel is an LSD radix of
+stable argsorts over orderable uint32 words (ops/kernels.py), which XLA
+lowers to fused bitonic sorts — the TPU replacement for cuDF Table.orderBy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Iterator, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, bucket_capacity, concat_batches)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
+    as_host_column
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops import kernels
+
+
+@dataclasses.dataclass
+class SortOrder:
+    """One sort key (Spark SortOrder analog). Defaults: asc, nulls first —
+    Spark's ASC NULLS FIRST."""
+
+    child: Expression
+    ascending: bool = True
+    nulls_first: bool = True
+
+
+def coalesce_to_single_batch(batches: List[DeviceBatch]) -> DeviceBatch:
+    """Concatenate a partition's batches into one (RequireSingleBatch goal,
+    GpuCoalesceBatches.scala:120)."""
+    if len(batches) == 1:
+        return batches[0]
+    total_cap = sum(b.capacity for b in batches)
+    return concat_batches(batches, bucket_capacity(total_cap))
+
+
+def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]) -> DeviceBatch:
+    """Device kernel: fully sort one batch by the sort orders."""
+    passes: List[jnp.ndarray] = []
+    for o in orders:
+        col = as_device_column(o.child.eval(batch), batch)
+        passes.extend(kernels.sort_key_passes(col, o.ascending,
+                                              o.nulls_first))
+    perm = kernels.lex_sort_perm(passes, batch.num_rows, batch.capacity)
+    return batch.gather(perm, batch.num_rows)
+
+
+class SortExec(Exec):
+    """Per-partition full sort (global order requires a range exchange
+    upstream, as in Spark)."""
+
+    def __init__(self, child: Exec, orders: Sequence[SortOrder]):
+        super().__init__(child)
+        self.orders = list(orders)
+        self._jit = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        batches = list(self.children[0].execute_device(ctx, partition))
+        if not batches:
+            return
+        single = coalesce_to_single_batch(batches)
+        if self._jit is None and all(o.child.jittable for o in self.orders):
+            self._jit = jax.jit(lambda b: sort_batch(b, self.orders))
+        fn = self._jit or (lambda b: sort_batch(b, self.orders))
+        with timed(m):
+            out = fn(single)
+        m.add("numOutputBatches", 1)
+        yield out
+
+    def execute_host(self, ctx, partition):
+        hbs = list(self.children[0].execute_host(ctx, partition))
+        if not hbs:
+            return
+        # Concat host batches column-wise.
+        names = hbs[0].names
+        cols = []
+        for ci, c0 in enumerate(hbs[0].columns):
+            data = np.concatenate([hb.columns[ci].data for hb in hbs])
+            validity = np.concatenate([hb.columns[ci].validity for hb in hbs])
+            cols.append(HostColumn(c0.dtype, data, validity))
+        merged = HostBatch(names, cols)
+        yield sort_host_batch(merged, self.orders)
+
+
+def sort_host_batch(hb: HostBatch, orders: Sequence[SortOrder]) -> HostBatch:
+    """Host oracle sort with Spark semantics (NaN greatest, null ordering)."""
+    n = hb.num_rows
+    keys = []
+    for o in orders:
+        col = as_host_column(o.child.eval_host(hb), hb)
+        keys.append((col, o))
+
+    def sort_key(i: int):
+        parts = []
+        for col, o in keys:
+            valid = bool(col.validity[i])
+            null_rank = 0 if (not valid) == o.nulls_first else 1
+            if not valid:
+                part = (null_rank, 0)
+            else:
+                v = col.data[i]
+                if col.dtype.is_string:
+                    v = bytes(v)
+                elif col.dtype.is_floating:
+                    f = float(v)
+                    # NaN greatest: map to +inf tier.
+                    v = (1, 0.0) if np.isnan(f) else (0, f)
+                elif col.dtype.is_boolean:
+                    v = bool(v)
+                else:
+                    v = int(v)
+                part = (null_rank, _Rev(v) if not o.ascending else v)
+            parts.append(part)
+        return tuple(parts)
+
+    order = sorted(range(n), key=sort_key)
+    cols = [HostColumn(c.dtype, c.data[order], c.validity[order])
+            for c in hb.columns]
+    return HostBatch(hb.names, cols)
+
+
+@functools.total_ordering
+class _Rev:
+    """Reverses comparison for descending host sort keys."""
+
+    def __init__(self, v):
+        self.v = v
+
+    def __eq__(self, other):
+        return self.v == other.v
+
+    def __lt__(self, other):
+        return other.v < self.v

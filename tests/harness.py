@@ -96,7 +96,8 @@ def _py_mix_k1(k1):
 
 
 def _py_mix_h1(h1, k1):
-    h1 ^= _py_mix_k1(k1) if False else k1  # k1 already mixed by caller
+    # k1 must already be mixed by the caller (matches Murmur3_x86_32).
+    h1 ^= k1
     h1 = _py_rotl(h1, 13)
     return (h1 * 5 + 0xE6546B64) & _M
 
