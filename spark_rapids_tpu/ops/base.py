@@ -45,10 +45,14 @@ class Metrics:
 
 @dataclasses.dataclass
 class ExecContext:
-    """Per-query execution context: conf + metrics sink."""
+    """Per-query execution context: conf + metrics sink + materialization
+    cache (shuffle buckets, broadcast batches, built join sides — the role
+    the reference's RapidsBufferCatalog/device store plays for shuffle
+    data, SURVEY.md §2.6)."""
 
     conf: TpuConf = dataclasses.field(default_factory=TpuConf)
     metrics: Dict[str, Metrics] = dataclasses.field(default_factory=dict)
+    cache: Dict[str, object] = dataclasses.field(default_factory=dict)
 
     def metrics_for(self, op: "Exec") -> Metrics:
         key = f"{type(op).__name__}@{id(op):x}"

@@ -1,0 +1,633 @@
+"""Hash joins (ref: shims/spark300 GpuHashJoin.scala:50,195,
+GpuShuffledHashJoinExec, GpuBroadcastHashJoinExec,
+GpuBroadcastNestedLoopJoinExec.scala, GpuCartesianProductExec.scala).
+
+TPU-first design — no hash table with chained buckets (pointer chasing is
+poison on the VPU). Instead, a sort-probe join over key fingerprints:
+
+  build side: fingerprint build keys (two murmur3 streams, ops/kernels.py),
+      sort build rows by fingerprint -> contiguous key groups, plus a
+      sorted fingerprint array for searching.
+  probe side: fingerprint probe keys, double binary search (searchsorted
+      left/right) into the sorted build fingerprints -> per-probe match
+      range [lo, hi).
+  expansion: total pairs = sum(hi - lo) is reduced on device, synced once,
+      and rounded up to a capacity bucket (the one host sync a join costs —
+      matching cuDF's join output-size computation). The expansion kernel
+      maps each output slot back to its (probe, build) pair with a
+      searchsorted over the running offsets — all dense vector ops.
+
+Join sides: inner, left/right outer, full outer, left semi, left anti, plus
+cross (nested loop) joins. An optional residual condition filters pairs
+post-expansion (non-equi predicates), with outer-join match bookkeeping done
+after the filter, like the reference's conditional join handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity, concat_batches)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
+    as_host_column
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops import kernels
+from spark_rapids_tpu.ops.sort import coalesce_to_single_batch
+
+JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")
+
+
+# ---------------------------------------------------------------------------
+# Device join kernels
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class BuiltSide:
+    """Build side prepared for probing: rows sorted by key fingerprint."""
+
+    batch: DeviceBatch          # rows in fingerprint-sorted order
+    fp: jnp.ndarray             # (cap,) uint64 sorted fingerprints
+    matchable: jnp.ndarray      # (cap,) bool: live AND non-null keys
+    row_live: jnp.ndarray       # (cap,) bool: live (incl. null-key rows)
+    num_rows: jnp.ndarray       # int32
+
+
+def _fingerprint64(batch: DeviceBatch, key_ordinals) -> jnp.ndarray:
+    ha, hb = kernels.key_fingerprint(
+        [batch.columns[i] for i in key_ordinals], batch.capacity)
+    return (ha.astype(jnp.uint64) << jnp.uint64(32)) | hb.astype(jnp.uint64)
+
+
+def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
+               null_safe: bool = False) -> BuiltSide:
+    """Sort build rows by fingerprint. Rows with null keys never match (SQL
+    equi-join), but stay alive for full-outer emission."""
+    fp = _fingerprint64(batch, key_ordinals)
+    row_live = batch.row_mask()
+    matchable = row_live
+    if not null_safe:
+        for i in key_ordinals:
+            matchable = matchable & batch.columns[i].validity
+    # Unmatchable rows sort to the end with the max fingerprint sentinel
+    # (padding after null-key rows). Columns are gathered manually (not
+    # batch.gather) because liveness is per-sorted-row, not a prefix.
+    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    key = jnp.where(matchable, fp, sentinel)
+    perm = jnp.argsort(key, stable=True)
+    s_live = jnp.take(row_live, perm, axis=0)
+    cols = tuple(c.gather(perm.astype(jnp.int32), s_live)
+                 for c in batch.columns)
+    sorted_batch = DeviceBatch(cols, batch.num_rows)
+    return BuiltSide(sorted_batch, jnp.take(key, perm, axis=0),
+                     jnp.take(matchable, perm, axis=0), s_live,
+                     batch.num_rows)
+
+
+def probe_ranges(built: BuiltSide, probe: DeviceBatch,
+                 key_ordinals: Sequence[int], null_safe: bool = False):
+    """Per-probe-row match range [lo, hi) in the sorted build side."""
+    fp = _fingerprint64(probe, key_ordinals)
+    plive = probe.row_mask()
+    if not null_safe:
+        for i in key_ordinals:
+            plive = plive & probe.columns[i].validity
+    lo = jnp.searchsorted(built.fp, fp, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(built.fp, fp, side="right").astype(jnp.int32)
+    counts = jnp.where(plive, hi - lo, 0)
+    return lo, counts, plive
+
+
+def expand_pairs(lo: jnp.ndarray, counts: jnp.ndarray, out_cap: int,
+                 probe_cap: int):
+    """Map output slots to (probe_row, build_row) pairs.
+
+    offsets = exclusive cumsum(counts); slot s belongs to probe row
+    p = upper_bound(offsets, s) - 1 and build row lo[p] + (s - offsets[p]).
+    """
+    offsets = jnp.cumsum(counts) - counts          # exclusive
+    total = jnp.sum(counts)
+    slots = jnp.arange(out_cap, dtype=jnp.int32)
+    p = (jnp.searchsorted(offsets, slots, side="right") - 1).astype(jnp.int32)
+    p = jnp.clip(p, 0, probe_cap - 1)
+    within = slots - jnp.take(offsets, p, axis=0)
+    b = jnp.take(lo, p, axis=0) + within.astype(jnp.int32)
+    valid = slots < total
+    return p, b, valid, total
+
+
+def _gather_cols(batch: DeviceBatch, rows: jnp.ndarray,
+                 valid: jnp.ndarray, null_out: jnp.ndarray = None):
+    """Gather columns at ``rows`` (out-capacity positions); ``null_out``
+    marks slots that must become NULL (outer-join no-match sides)."""
+    cols = []
+    for c in batch.columns:
+        dst_valid = jnp.take(c.validity, rows, axis=0, mode="clip") & valid
+        if null_out is not None:
+            dst_valid = dst_valid & ~null_out
+        cols.append(c.gather(rows, dst_valid))
+    return cols
+
+
+def _join_schema(left: Schema, right: Schema, join_type: str) -> Schema:
+    if join_type in ("semi", "anti"):
+        return left
+    return tuple(left) + tuple(right)
+
+
+class _JoinKernelMixin:
+    """Shared device join logic over a built (single-batch) build side and a
+    streamed probe side. Subclasses decide which input is which."""
+
+    def _device_join_stream(self, ctx, built: BuiltSide, probe_iter,
+                            probe_keys, build_is_right: bool):
+        jt = self.join_type
+        cond = self.condition
+        build_cap = built.batch.capacity
+        # Full outer: build-side coverage accumulates over the whole probe
+        # stream and unmatched build rows are emitted once at the end.
+        covered_acc = jnp.zeros((build_cap,), jnp.bool_) \
+            if jt == "full" else None
+        for pbatch in probe_iter:
+            lo, counts, plive = probe_ranges(built, pbatch, probe_keys)
+            # Semi/anti need no expansion when there is no condition.
+            if jt in ("semi", "anti") and cond is None:
+                keep = (counts > 0) if jt == "semi" else (counts == 0)
+                yield pbatch.compact(keep & pbatch.row_mask())
+                continue
+            total = int(jnp.sum(counts))
+            out_cap = bucket_capacity(max(total, 1))
+            out, covered = self._emit_expanded(
+                built, pbatch, lo, counts, plive, out_cap, build_is_right)
+            if covered_acc is not None and covered is not None:
+                covered_acc = covered_acc | covered
+            yield out
+        if covered_acc is not None:
+            build_unmatched = ~covered_acc & built.row_live
+            # A fake empty probe batch supplies the null side's schema.
+            yield self._null_extend_build(
+                built, build_unmatched, self._probe_schema_batch(),
+                build_is_right)
+
+    def _probe_schema_batch(self) -> DeviceBatch:
+        build_right = self.join_type != "right"
+        probe_child = self.children[0] if build_right else self.children[1]
+        return _empty_like(probe_child.schema)
+
+    def _emit_expanded(self, built: BuiltSide, pbatch: DeviceBatch,
+                       lo, counts, plive, out_cap: int,
+                       build_is_right: bool):
+        """Expand matches for one probe batch. Returns (out_batch,
+        covered_build_rows_or_None)."""
+        jt = self.join_type
+        cond = self.condition
+        probe_cap = pbatch.capacity
+        p, b, valid, total = expand_pairs(lo, counts, out_cap, probe_cap)
+        probe_cols = _gather_cols(pbatch, p, valid)
+        build_cols = _gather_cols(built.batch, b, valid)
+        if build_is_right:
+            left_cols, right_cols = probe_cols, build_cols
+        else:
+            left_cols, right_cols = build_cols, probe_cols
+        pairs = DeviceBatch(tuple(left_cols) + tuple(right_cols), total)
+
+        if cond is not None:
+            c = as_device_column(cond.eval(pairs), pairs)
+            cond_keep = c.data & c.validity & valid
+        else:
+            cond_keep = valid
+
+        if jt in ("inner", "cross"):
+            return pairs.compact(cond_keep), None
+        if jt in ("semi", "anti"):
+            hit = jax.ops.segment_max(
+                cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
+            keep = (hit if jt == "semi" else ~hit) & pbatch.row_mask()
+            return pbatch.compact(keep), None
+        # Outer joins: survivors + unmatched probe rows with NULL side.
+        survivors = pairs.compact(cond_keep)
+        probe_hit = jax.ops.segment_max(
+            cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
+        probe_unmatched = ~probe_hit & pbatch.row_mask()
+        extra = self._null_extend(pbatch, probe_unmatched, built,
+                                  build_is_right)
+        out = concat_batches(
+            [survivors, extra],
+            bucket_capacity(survivors.capacity + extra.capacity))
+        if jt == "full":
+            build_cap = built.batch.capacity
+            covered = jax.ops.segment_max(
+                (cond_keep & valid).astype(jnp.int32),
+                jnp.clip(b, 0, build_cap - 1), num_segments=build_cap) > 0
+            return out, covered
+        return out, None
+
+    def _null_extend(self, pbatch: DeviceBatch, keep, built: BuiltSide,
+                     build_is_right: bool) -> DeviceBatch:
+        """Probe rows with a NULL build side."""
+        kept = pbatch.compact(keep)
+        nulls = [DeviceColumn.full_null(
+            c.dtype, kept.capacity,
+            c.string_width if c.dtype.is_string else 8)
+            for c in built.batch.columns]
+        if build_is_right:
+            cols = tuple(kept.columns) + tuple(nulls)
+        else:
+            cols = tuple(nulls) + tuple(kept.columns)
+        return DeviceBatch(cols, kept.num_rows)
+
+    def _null_extend_build(self, built: BuiltSide, keep, pbatch: DeviceBatch,
+                           build_is_right: bool) -> DeviceBatch:
+        kept = built.batch.compact(keep)
+        nulls = [DeviceColumn.full_null(
+            c.dtype, kept.capacity,
+            c.string_width if c.dtype.is_string else 8)
+            for c in pbatch.columns]
+        if build_is_right:
+            cols = tuple(nulls) + tuple(kept.columns)
+        else:
+            cols = tuple(kept.columns) + tuple(nulls)
+        return DeviceBatch(cols, kept.num_rows)
+
+
+# ---------------------------------------------------------------------------
+# Execs
+# ---------------------------------------------------------------------------
+
+class ShuffledHashJoinExec(Exec, _JoinKernelMixin):
+    """Both sides co-partitioned by key (GpuShuffledHashJoinExec). The build
+    side (right for left/inner/..., left for 'right' joins) is coalesced to
+    a single batch per partition — RequireSingleBatch, as in the reference.
+    """
+
+    def __init__(self, left: Exec, right: Exec,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 join_type: str = "inner",
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        assert join_type in JOIN_TYPES
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def schema(self) -> Schema:
+        return _join_schema(self.children[0].schema,
+                            self.children[1].schema, self.join_type)
+
+    def num_partitions(self, ctx) -> int:
+        return self.children[0].num_partitions(ctx)
+
+    def _key_ordinals(self, side: Exec, keys) -> List[int]:
+        # Keys must be bound references for the kernel; project otherwise.
+        from spark_rapids_tpu.exprs.base import BoundReference
+        ords = []
+        for k in keys:
+            assert isinstance(k, BoundReference), \
+                "join keys must be pre-projected BoundReferences"
+            ords.append(k.ordinal)
+        return ords
+
+    def execute_device(self, ctx, partition):
+        # 'right' join probes with the right side preserved: build LEFT.
+        build_right = self.join_type != "right"
+        build_child = self.children[1] if build_right else self.children[0]
+        probe_child = self.children[0] if build_right else self.children[1]
+        build_keys = self.right_keys if build_right else self.left_keys
+        probe_keys = self.left_keys if build_right else self.right_keys
+        bbatches = list(build_child.execute_device(ctx, partition))
+        if not bbatches:
+            if self.join_type in ("inner", "semi", "cross"):
+                return
+            bbatches = []
+        probe_iter = probe_child.execute_device(ctx, partition)
+        if not bbatches:
+            # Outer/anti with empty build: every probe row is unmatched.
+            for pbatch in probe_iter:
+                if self.join_type == "anti":
+                    yield pbatch
+                elif self.join_type in ("left", "right", "full"):
+                    empty = _empty_like(build_child.schema)
+                    built = build_side(empty, list(range(
+                        len(self._key_ordinals(build_child, build_keys)))))
+                    yield self._null_extend(
+                        pbatch, pbatch.row_mask(), built, build_right)
+            return
+        single = coalesce_to_single_batch(bbatches)
+        built = build_side(single, self._key_ordinals(build_child,
+                                                      build_keys))
+        yield from self._device_join_stream(
+            ctx, built, probe_iter,
+            self._key_ordinals(probe_child, probe_keys), build_right)
+
+    # -- host oracle ---------------------------------------------------------
+    def execute_host(self, ctx, partition):
+        yield from _host_join(self, ctx, partition)
+
+
+class BroadcastHashJoinExec(ShuffledHashJoinExec):
+    """Build side pre-broadcast (wrapped in BroadcastExchangeExec); probe
+    side streams its partitions (GpuBroadcastHashJoinExec)."""
+
+    def num_partitions(self, ctx) -> int:
+        probe = self.children[0] if self.join_type != "right" else \
+            self.children[1]
+        return probe.num_partitions(ctx)
+
+    def execute_device(self, ctx, partition):
+        build_right = self.join_type != "right"
+        build_child = self.children[1] if build_right else self.children[0]
+        probe_child = self.children[0] if build_right else self.children[1]
+        build_keys = self.right_keys if build_right else self.left_keys
+        probe_keys = self.left_keys if build_right else self.right_keys
+        # Full outer over a broadcast build would emit build-unmatched rows
+        # once per probe partition; Spark never plans that shape either.
+        assert self.join_type != "full" or \
+            probe_child.num_partitions(ctx) == 1, \
+            "full outer join requires a shuffled (co-partitioned) plan"
+        probe_iter = probe_child.execute_device(ctx, partition)
+        # The BuiltSide (collection + fingerprint sort of the broadcast
+        # table) is built once and shared across probe partitions.
+        cache_key = f"builtside:{id(self):x}"
+        built = ctx.cache.get(cache_key)
+        if built is None:
+            bbatches = []
+            for cp in range(build_child.num_partitions(ctx)):
+                bbatches.extend(build_child.execute_device(ctx, cp))
+            if bbatches:
+                single = coalesce_to_single_batch(bbatches)
+                built = build_side(single, self._key_ordinals(
+                    build_child, build_keys))
+            else:
+                built = "EMPTY"
+            ctx.cache[cache_key] = built
+        if built == "EMPTY":
+            for pbatch in probe_iter:
+                if self.join_type == "anti":
+                    yield pbatch
+                elif self.join_type in ("left", "right", "full"):
+                    empty = _empty_like(build_child.schema)
+                    eb = build_side(empty, [0] if build_keys else [])
+                    yield self._null_extend(pbatch, pbatch.row_mask(),
+                                            eb, build_right)
+            return
+        yield from self._device_join_stream(
+            ctx, built, probe_iter,
+            self._key_ordinals(probe_child, probe_keys), build_right)
+
+
+class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
+    """Cross / conditional nested-loop join: every probe (left) row pairs
+    with every build (right/broadcast) row
+    (GpuBroadcastNestedLoopJoinExec.scala). Output capacity is
+    probe_cap * build_cap per batch pair — keep the build side small.
+
+    'right' preserves the build side, 'left'/'full' the usual semantics;
+    right/full require a single probe partition (build-unmatched rows are
+    emitted once), matching how Spark plans these only when viable."""
+
+    def __init__(self, left: Exec, right: Exec,
+                 join_type: str = "cross",
+                 condition: Optional[Expression] = None):
+        super().__init__(left, right)
+        assert join_type in JOIN_TYPES
+        self.join_type = join_type
+        self.condition = condition
+
+    @property
+    def schema(self) -> Schema:
+        return _join_schema(self.children[0].schema,
+                            self.children[1].schema, self.join_type)
+
+    def num_partitions(self, ctx) -> int:
+        return self.children[0].num_partitions(ctx)
+
+    def execute_device(self, ctx, partition):
+        jt = self.join_type
+        assert jt not in ("right", "full") or \
+            self.num_partitions(ctx) == 1, \
+            f"nested-loop {jt} join needs a single probe partition"
+        bbatches = []
+        for cp in range(self.children[1].num_partitions(ctx)):
+            bbatches.extend(self.children[1].execute_device(ctx, cp))
+        probe_iter = self.children[0].execute_device(ctx, partition)
+        if not bbatches:
+            # Empty build side: left/full keep probes null-extended, anti
+            # keeps all probes, inner/cross/semi/right emit nothing.
+            empty = _empty_like(self.children[1].schema)
+            built = BuiltSide(empty, None, empty.row_mask(),
+                              empty.row_mask(), empty.num_rows)
+            for pbatch in probe_iter:
+                if jt == "anti":
+                    yield pbatch
+                elif jt in ("left", "full"):
+                    yield self._null_extend(pbatch, pbatch.row_mask(),
+                                            built, True)
+            return
+        build = coalesce_to_single_batch(bbatches)
+        built = BuiltSide(build, None, build.row_mask(),
+                          build.row_mask(), build.num_rows)
+        bcap = build.capacity
+        covered_acc = jnp.zeros((bcap,), jnp.bool_) \
+            if jt in ("right", "full") else None
+        for pbatch in probe_iter:
+            pcap = pbatch.capacity
+            # lo=0, count=num_build_rows for every live probe row.
+            lo = jnp.zeros((pcap,), jnp.int32)
+            counts = jnp.where(pbatch.row_mask(),
+                               build.num_rows.astype(jnp.int32), 0)
+            out_cap = bucket_capacity(
+                max(int(pbatch.num_rows) * int(build.num_rows), 1))
+            out, covered = self._nlj_emit(built, pbatch, lo, counts,
+                                          out_cap)
+            if covered_acc is not None and covered is not None:
+                covered_acc = covered_acc | covered
+            if out is not None:
+                yield out
+        if covered_acc is not None:
+            build_unmatched = ~covered_acc & built.row_live
+            yield self._null_extend_build(
+                built, build_unmatched,
+                _empty_like(self.children[0].schema), True)
+
+    def _nlj_emit(self, built, pbatch, lo, counts, out_cap):
+        """Like _emit_expanded but with nested-loop join-type semantics:
+        the probe is always the LEFT side; 'right' preserves the build."""
+        jt = self.join_type
+        cond = self.condition
+        probe_cap = pbatch.capacity
+        bcap = built.batch.capacity
+        p, b, valid, total = expand_pairs(lo, counts, out_cap, probe_cap)
+        left_cols = _gather_cols(pbatch, p, valid)
+        right_cols = _gather_cols(built.batch, b, valid)
+        pairs = DeviceBatch(tuple(left_cols) + tuple(right_cols), total)
+        if cond is not None:
+            c = as_device_column(cond.eval(pairs), pairs)
+            cond_keep = c.data & c.validity & valid
+        else:
+            cond_keep = valid
+        covered = None
+        if jt in ("right", "full"):
+            covered = jax.ops.segment_max(
+                (cond_keep & valid).astype(jnp.int32),
+                jnp.clip(b, 0, bcap - 1), num_segments=bcap) > 0
+        if jt in ("inner", "cross"):
+            return pairs.compact(cond_keep), covered
+        if jt in ("semi", "anti"):
+            hit = jax.ops.segment_max(
+                cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
+            keep = (hit if jt == "semi" else ~hit) & pbatch.row_mask()
+            return pbatch.compact(keep), covered
+        if jt == "right":
+            # Only matched pairs here; unmatched build rows come at end.
+            return pairs.compact(cond_keep), covered
+        # left / full: survivors + probe-unmatched null-extended.
+        survivors = pairs.compact(cond_keep)
+        probe_hit = jax.ops.segment_max(
+            cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
+        probe_unmatched = ~probe_hit & pbatch.row_mask()
+        extra = self._null_extend(pbatch, probe_unmatched, built, True)
+        return concat_batches(
+            [survivors, extra],
+            bucket_capacity(survivors.capacity + extra.capacity)), covered
+
+    def execute_host(self, ctx, partition):
+        yield from _host_join(self, ctx, partition, nested_loop=True)
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+def _empty_like(schema: Schema) -> DeviceBatch:
+    cols = []
+    for _, t in schema:
+        cols.append(DeviceColumn.full_null(t, 8))
+    return DeviceBatch(tuple(cols), jnp.asarray(0, jnp.int32))
+
+
+def _host_join(op, ctx, partition, nested_loop: bool = False):
+    """Host oracle: nested-loop evaluation with SQL equi-join null
+    semantics. O(n*m) — fine for tests."""
+    def _collect(child):
+        out = []
+        for cp in range(child.num_partitions(ctx)):
+            for hb in child.execute_host(ctx, cp):
+                out.extend(hb.to_pylist())
+        return out
+
+    # For shuffled joins the oracle joins per partition; for broadcast the
+    # build side is global. Simplest correct oracle: join THIS partition's
+    # probe rows against the appropriate build rows.
+    if isinstance(op, BroadcastNestedLoopJoinExec):
+        left_rows = []
+        for hb in op.children[0].execute_host(ctx, partition):
+            left_rows.extend(hb.to_pylist())
+        right_rows = _collect(op.children[1])
+        lkeys = rkeys = None
+    elif isinstance(op, BroadcastHashJoinExec):
+        if op.join_type != "right":
+            left_rows = []
+            for hb in op.children[0].execute_host(ctx, partition):
+                left_rows.extend(hb.to_pylist())
+            right_rows = _collect(op.children[1])
+        else:
+            left_rows = _collect(op.children[0])
+            right_rows = []
+            for hb in op.children[1].execute_host(ctx, partition):
+                right_rows.extend(hb.to_pylist())
+        lkeys, rkeys = op.left_keys, op.right_keys
+    else:
+        left_rows = []
+        for hb in op.children[0].execute_host(ctx, partition):
+            left_rows.extend(hb.to_pylist())
+        right_rows = []
+        for hb in op.children[1].execute_host(ctx, partition):
+            right_rows.extend(hb.to_pylist())
+        lkeys, rkeys = op.left_keys, op.right_keys
+
+    lschema = op.children[0].schema
+    rschema = op.children[1].schema
+    jt = op.join_type
+    cond = op.condition
+
+    def key_of(row, keys):
+        if keys is None:
+            return ()
+        vals = []
+        for k in keys:
+            v = row[k.ordinal]
+            if isinstance(v, float):
+                if np.isnan(v):
+                    v = "NaN"
+                elif v == 0.0:
+                    v = 0.0
+            vals.append(v)
+        return tuple(vals)
+
+    def keys_ok(row, keys):
+        return keys is None or all(row[k.ordinal] is not None for k in keys)
+
+    def cond_ok(lrow, rrow):
+        if cond is None:
+            return True
+        combined = lrow + rrow
+        hb = _rows_to_hb([combined], tuple(lschema) + tuple(rschema))
+        c = as_host_column(cond.eval_host(hb), hb)
+        return bool(c.validity[0]) and bool(c.data[0])
+
+    out = []
+    matched_right = [False] * len(right_rows)
+    for lrow in left_rows:
+        matches = []
+        if nested_loop or keys_ok(lrow, lkeys):
+            for ri, rrow in enumerate(right_rows):
+                if not nested_loop:
+                    if not keys_ok(rrow, rkeys):
+                        continue
+                    if key_of(lrow, lkeys) != key_of(rrow, rkeys):
+                        continue
+                if cond_ok(lrow, rrow):
+                    matches.append(ri)
+        if jt in ("inner", "cross"):
+            for ri in matches:
+                out.append(lrow + right_rows[ri])
+        elif jt == "semi":
+            if matches:
+                out.append(lrow)
+        elif jt == "anti":
+            if not matches:
+                out.append(lrow)
+        elif jt in ("left", "full"):
+            if matches:
+                for ri in matches:
+                    out.append(lrow + right_rows[ri])
+            else:
+                out.append(lrow + (None,) * len(rschema))
+        elif jt == "right":
+            for ri in matches:
+                out.append(lrow + right_rows[ri])
+        for ri in matches:
+            matched_right[ri] = True
+    if jt in ("right", "full"):
+        for ri, rrow in enumerate(right_rows):
+            if not matched_right[ri]:
+                out.append((None,) * len(lschema) + rrow)
+    yield _rows_to_hb(out, op.schema)
+
+
+def _rows_to_hb(rows, schema) -> HostBatch:
+    names = tuple(n for n, _ in schema)
+    cols = []
+    for ci, (_, t) in enumerate(schema):
+        cols.append(HostColumn.from_values(t, [r[ci] for r in rows]))
+    return HostBatch(names, cols)

@@ -1,0 +1,8 @@
+"""Parallelism & distribution (SURVEY.md §2.5/§2.6): partitioning
+strategies, shuffle/broadcast exchanges, device-mesh collectives."""
+
+from spark_rapids_tpu.parallel.partitioning import (   # noqa: F401
+    HashPartitioning, Partitioning, RangePartitioning,
+    RoundRobinPartitioning, SinglePartitioning, split_batch)
+from spark_rapids_tpu.parallel.exchange import (       # noqa: F401
+    BroadcastExchangeExec, ShuffleExchangeExec)

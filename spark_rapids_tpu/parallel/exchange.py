@@ -1,0 +1,199 @@
+"""Shuffle & broadcast exchanges (ref: GpuShuffleExchangeExec.scala:69,145,
+GpuBroadcastExchangeExec.scala:237, ShuffledBatchRDD.scala).
+
+Single-host execution model: the exchange materializes the child once per
+query context (the role Spark's shuffle files / the reference's
+RapidsCachingWriter device-store play — see RapidsShuffleInternalManager
+write path, SURVEY.md §3.4), bucketing every batch by partition id. Reduce
+tasks then stream their bucket. The multi-chip path replaces this
+materialization with an ICI all-to-all collective (parallel/mesh.py) — a
+planned collective exchange instead of a pull protocol, per SURVEY.md §2.6's
+TPU mapping note.
+
+A sampled range exchange computes bounds from a host sample first, like
+GpuRangePartitioner's reservoir sample.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar.batch import DeviceBatch, bucket_capacity, \
+    concat_batches
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.parallel.partitioning import (
+    Partitioning, RangePartitioning, split_batch, split_host_batch)
+
+
+class ShuffleExchangeExec(Exec):
+    """Repartition the child by a Partitioning strategy."""
+
+    def __init__(self, child: Exec, partitioning: Partitioning):
+        super().__init__(child)
+        self.partitioning = partitioning
+        self._split_jit = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self, ctx) -> int:
+        return self.partitioning.num_partitions
+
+    # -- materialization (the "map side") ------------------------------------
+    def _cache_key(self, device: bool) -> str:
+        return f"shuffle:{id(self):x}:{'dev' if device else 'host'}"
+
+    def _ensure_bounds(self, ctx, device: bool):
+        """Range partitioning needs bounds from a sample of the keys."""
+        p = self.partitioning
+        if not isinstance(p, RangePartitioning) or p.bounds is not None:
+            return
+        # Sample: pull up to 64 rows per child partition on the host engine
+        # (CPU-side sampling, like the reference).
+        from spark_rapids_tpu.columnar.host import device_to_host
+        samples: List[HostBatch] = []
+        for cp in range(self.children[0].num_partitions(ctx)):
+            it = (self.children[0].execute_device(ctx, cp) if device
+                  else self.children[0].execute_host(ctx, cp))
+            for b in it:
+                hb = device_to_host(b) if device else b
+                keycols = []
+                from spark_rapids_tpu.exprs.base import as_host_column
+                for o in p.orders:
+                    keycols.append(as_host_column(o.child.eval_host(hb), hb))
+                n = min(64, hb.num_rows)
+                idx = np.linspace(0, max(hb.num_rows - 1, 0), n,
+                                  dtype=np.int64) if n else \
+                    np.zeros(0, np.int64)
+                cols = [HostColumn(c.dtype, c.data[idx], c.validity[idx])
+                        for c in keycols]
+                samples.append(HostBatch(
+                    tuple(f"k{i}" for i in range(len(cols))), cols))
+                break   # one batch per partition is enough for bounds
+        if not samples:
+            p.bounds = HostBatch((), [])
+            return
+        merged_cols = []
+        for ci in range(samples[0].num_columns):
+            data = np.concatenate([s.columns[ci].data for s in samples])
+            val = np.concatenate([s.columns[ci].validity for s in samples])
+            merged_cols.append(HostColumn(samples[0].columns[ci].dtype,
+                                          data, val))
+        merged = HostBatch(samples[0].names, merged_cols)
+        # Bounds are picked over the key columns themselves, so the sort
+        # orders must reference them by ordinal.
+        from spark_rapids_tpu.exprs.base import BoundReference
+        from spark_rapids_tpu.ops.sort import SortOrder
+        bound_orders = [
+            SortOrder(BoundReference(i, o.child.data_type()),
+                      o.ascending, o.nulls_first)
+            for i, o in enumerate(p.orders)]
+        # The bounds batch holds the key columns positionally; see
+        # RangePartitioning._bound_words.
+        p.bounds = RangePartitioning.compute_bounds(
+            merged, bound_orders, p.num_partitions)
+
+    def _materialize_device(self, ctx) -> List[List[DeviceBatch]]:
+        key = self._cache_key(True)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        self._ensure_bounds(ctx, device=True)
+        n = self.partitioning.num_partitions
+        buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        split_fn = lambda b: split_batch(
+            b, self.partitioning.partition_ids(b), n)
+        split = jax.jit(split_fn) if self.partitioning.jittable else split_fn
+        for cp in range(self.children[0].num_partitions(ctx)):
+            for batch in self.children[0].execute_device(ctx, cp):
+                pieces = split(batch)
+                for p, piece in enumerate(pieces):
+                    buckets[p].append(piece)
+        ctx.cache[key] = buckets
+        return buckets
+
+    def _materialize_host(self, ctx) -> List[List[HostBatch]]:
+        key = self._cache_key(False)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        self._ensure_bounds(ctx, device=False)
+        n = self.partitioning.num_partitions
+        buckets: List[List[HostBatch]] = [[] for _ in range(n)]
+        for cp in range(self.children[0].num_partitions(ctx)):
+            for hb in self.children[0].execute_host(ctx, cp):
+                pids = self.partitioning.partition_ids_host(hb)
+                for p, piece in enumerate(split_host_batch(hb, pids, n)):
+                    buckets[p].append(piece)
+        ctx.cache[key] = buckets
+        return buckets
+
+    # -- serving (the "reduce side") -----------------------------------------
+    def execute_device(self, ctx, partition):
+        buckets = self._materialize_device(ctx)
+        yield from iter(buckets[partition])
+
+    def execute_host(self, ctx, partition):
+        buckets = self._materialize_host(ctx)
+        yield from iter(buckets[partition])
+
+
+class BroadcastExchangeExec(Exec):
+    """Collect the whole child into ONE batch replicated to every consumer
+    (GpuBroadcastExchangeExec: collect-to-driver + re-upload becomes, on a
+    pod, a one-time all-gather; single-host it is a concat + cache)."""
+
+    def __init__(self, child: Exec):
+        super().__init__(child)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self, ctx) -> int:
+        return 1
+
+    def _cache_key(self, device: bool) -> str:
+        return f"broadcast:{id(self):x}:{'dev' if device else 'host'}"
+
+    def collect_single_device(self, ctx) -> DeviceBatch:
+        key = self._cache_key(True)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        batches = []
+        for cp in range(self.children[0].num_partitions(ctx)):
+            batches.extend(self.children[0].execute_device(ctx, cp))
+        if not batches:
+            raise ValueError("broadcast of empty child needs a schema batch")
+        total = sum(b.capacity for b in batches)
+        single = batches[0] if len(batches) == 1 else \
+            concat_batches(batches, bucket_capacity(total))
+        ctx.cache[key] = single
+        return single
+
+    def collect_single_host(self, ctx) -> HostBatch:
+        key = self._cache_key(False)
+        if key in ctx.cache:
+            return ctx.cache[key]
+        hbs = []
+        for cp in range(self.children[0].num_partitions(ctx)):
+            hbs.extend(self.children[0].execute_host(ctx, cp))
+        assert hbs, "broadcast of empty child"
+        cols = []
+        for ci, c0 in enumerate(hbs[0].columns):
+            data = np.concatenate([hb.columns[ci].data for hb in hbs])
+            val = np.concatenate([hb.columns[ci].validity for hb in hbs])
+            cols.append(HostColumn(c0.dtype, data, val))
+        merged = HostBatch(hbs[0].names, cols)
+        ctx.cache[key] = merged
+        return merged
+
+    def execute_device(self, ctx, partition):
+        yield self.collect_single_device(ctx)
+
+    def execute_host(self, ctx, partition):
+        yield self.collect_single_host(ctx)
