@@ -1,0 +1,100 @@
+"""Benchmark: TPC-H q1-shaped columnar aggregate on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The workload mirrors BASELINE.md's first target config (scan+filter+agg,
+the TPC-H q1/q6 shape): filter -> groupby(2 keys) -> sum/sum/avg/count over
+a synthetic 4-column table. ``value`` is device rows/sec through the full
+jitted pipeline (including the iterative partial/merge aggregation);
+``vs_baseline`` is the speedup over this repo's host (numpy) engine on the
+same machine — the stand-in for the reference's GPU-vs-CPU-Spark headline
+(docs/FAQ.md:60-66 claims >=3x typical; published numbers are absent, see
+BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+DEVICE_ROWS = 1 << 20       # 1M rows through the device pipeline
+HOST_ROWS = 1 << 17         # host oracle is python-loop based; sample+scale
+ITERS = 5
+
+
+def make_host_batch(n_rows: int, seed: int = 0):
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.host import HostBatch
+    rng = np.random.default_rng(seed)
+    return HostBatch.from_pydict(
+        [("flag", dt.INT32), ("status", dt.INT32),
+         ("qty", dt.INT64), ("price", dt.FLOAT64)],
+        {"flag": rng.integers(0, 3, n_rows).tolist(),
+         "status": rng.integers(0, 2, n_rows).tolist(),
+         "qty": rng.integers(1, 50, n_rows).tolist(),
+         "price": (rng.random(n_rows) * 1000).tolist()})
+
+
+def device_pipeline():
+    import jax
+    import jax.numpy as jnp
+    import __graft_entry__ as g
+    fn, _ = g.entry()
+    return jax.jit(fn)
+
+
+def bench_device() -> float:
+    import jax
+    from spark_rapids_tpu.columnar.host import host_to_device
+    hb = make_host_batch(DEVICE_ROWS)
+    batch = host_to_device(hb, capacity=DEVICE_ROWS)
+    fn = device_pipeline()
+    out = fn(batch)
+    jax.block_until_ready(out.num_rows)   # compile + warmup
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(batch)
+    jax.block_until_ready(out.num_rows)
+    dt_s = (time.perf_counter() - t0) / ITERS
+    return DEVICE_ROWS / dt_s
+
+
+def bench_host() -> float:
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.exprs.base import BoundReference as Ref, lit
+    from spark_rapids_tpu import exprs as E
+    from spark_rapids_tpu.ops import (
+        AggSpec, Average, CountStar, FilterExec, HashAggregateExec,
+        InMemorySourceExec, Sum)
+    hb = make_host_batch(HOST_ROWS)
+    schema = (("flag", dt.INT32), ("status", dt.INT32),
+              ("qty", dt.INT64), ("price", dt.FLOAT64))
+    src = InMemorySourceExec(schema, [[hb]])
+    plan = HashAggregateExec(
+        FilterExec(src, E.LessThanOrEqual(Ref(2, dt.INT64), lit(45))),
+        [("flag", Ref(0, dt.INT32)), ("status", Ref(1, dt.INT32))],
+        [AggSpec("sum_qty", Sum(Ref(2, dt.INT64))),
+         AggSpec("sum_price", Sum(Ref(3, dt.FLOAT64))),
+         AggSpec("avg_qty", Average(Ref(2, dt.INT64))),
+         AggSpec("count", CountStar(None))])
+    t0 = time.perf_counter()
+    plan.collect(device=False)
+    dt_s = time.perf_counter() - t0
+    return HOST_ROWS / dt_s
+
+
+def main():
+    device_rps = bench_device()
+    host_rps = bench_host()
+    print(json.dumps({
+        "metric": "tpch_q1like_device_rows_per_sec",
+        "value": round(device_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(device_rps / host_rps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

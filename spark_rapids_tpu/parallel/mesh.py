@@ -1,0 +1,180 @@
+"""Device-mesh collectives: the ICI/DCN distribution layer
+(ref: SURVEY.md §2.6 TPU mapping — the UCX client/server pull protocol of
+shuffle-plugin/.../ucx/UCX.scala becomes a *planned collective exchange*).
+
+Design (scaling-book recipe): pick a mesh, annotate shardings, let XLA
+insert collectives.
+- One logical table = one DeviceBatch per device, sharded over the ``data``
+  mesh axis (per-partition data parallelism, SURVEY.md §2.5).
+- Hash shuffle = ``jax.lax.all_to_all`` over ICI: each device splits its
+  batch into per-destination pieces (the contiguousSplit analog), the
+  collective transposes piece ownership, receivers concatenate.
+- Broadcast join build = ``all_gather`` once (GpuBroadcastExchangeExec).
+- Partial->final aggregation crosses the exchange exactly like the
+  reference's partial/final GpuHashAggregate pair.
+
+Everything here is shape-static and runs under ``shard_map`` + ``jit``; the
+driver validates it on an N-virtual-device CPU mesh
+(xla_force_host_platform_device_count) exactly like tests/conftest.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.parallel.mesh_compat import shard_map
+
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, bucket_capacity, concat_batches)
+from spark_rapids_tpu.parallel.partitioning import Partitioning, split_batch
+
+DATA_AXIS = "data"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis: str = DATA_AXIS) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS):
+    """Sharding that splits every batch leaf's leading (row) axis across the
+    mesh — used to lay out a logical table as one shard per device."""
+    return NamedSharding(mesh, P(axis))
+
+
+# ---------------------------------------------------------------------------
+# Collective shuffle (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def all_to_all_exchange(batch: DeviceBatch, pids: jnp.ndarray,
+                        n_devices: int,
+                        axis: str = DATA_AXIS) -> DeviceBatch:
+    """ICI hash-shuffle step for one device's shard (call under shard_map).
+
+    Splits the local batch into per-destination pieces, exchanges piece
+    ownership with ``all_to_all`` (one fused ICI collective, not a peer
+    pull protocol), and concatenates the received pieces.
+    """
+    pieces = split_batch(batch, pids, n_devices)
+    # Stack piece leaves -> leading axis = destination device.
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pieces)
+    received = jax.lax.all_to_all(stacked, axis, split_axis=0,
+                                  concat_axis=0, tiled=False)
+    # received leaf shape == stacked leaf shape; index i = piece from peer i.
+    parts = [jax.tree.map(lambda x, i=i: x[i], received)
+             for i in range(n_devices)]
+    total_cap = sum(p.capacity for p in parts)
+    return concat_batches(parts, bucket_capacity(total_cap))
+
+
+def all_gather_batch(batch: DeviceBatch, n_devices: int,
+                     axis: str = DATA_AXIS) -> DeviceBatch:
+    """Replicate every device's shard to all devices (broadcast build side:
+    the one-time all-gather replacing collect+torrent-broadcast+re-upload).
+    """
+    gathered = jax.lax.all_gather(batch, axis, axis=0, tiled=False)
+    parts = [jax.tree.map(lambda x, i=i: x[i], gathered)
+             for i in range(n_devices)]
+    total_cap = sum(p.capacity for p in parts)
+    return concat_batches(parts, bucket_capacity(total_cap))
+
+
+# ---------------------------------------------------------------------------
+# Distributed plan step: shard_map over a q1-shaped pipeline
+# ---------------------------------------------------------------------------
+
+def distributed_aggregate_step(mesh: Mesh, agg_exec,
+                               partitioning: Partitioning,
+                               axis: str = DATA_AXIS):
+    """Build a jitted distributed aggregation step over ``mesh``.
+
+    Per device (under shard_map):
+      partial = local groupby update of the device's shard
+      exchanged = all_to_all by hash(key) pmod n  (ICI shuffle)
+      final = merge + finalize of the received partials
+
+    ``agg_exec`` is a HashAggregateExec used purely for its kernels
+    (update/merge/finalize are pure batch->batch functions).
+    """
+    n = mesh.devices.size
+
+    def step(local_batch: DeviceBatch) -> DeviceBatch:
+        partial = agg_exec._update_batch(local_batch,
+                                         jnp.asarray(0, jnp.int64))
+        pids = partitioning.partition_ids(partial)
+        exchanged = all_to_all_exchange(partial, pids, n, axis)
+        merged = agg_exec._merge_batch(exchanged)
+        return agg_exec._finalize_batch(merged)
+
+    def wrapped(stacked_local):
+        # in_specs P(axis) leaves a unit device axis on each leaf locally.
+        local = jax.tree.map(lambda x: x[0], stacked_local)
+        out = step(local)
+        return jax.tree.map(lambda x: x[None], out)
+
+    sharded = shard_map(wrapped, mesh, in_specs=(P(axis),),
+                        out_specs=P(axis))
+    return jax.jit(sharded)
+
+
+def distributed_join_agg_step(mesh: Mesh, join_exec, agg_exec,
+                              join_partitioning_left,
+                              join_partitioning_right,
+                              agg_partitioning,
+                              axis: str = DATA_AXIS):
+    """Distributed join + aggregate step (TPC-H q3-shaped):
+
+    per device: all_to_all both sides by join key -> local hash join ->
+    partial agg -> all_to_all by group key -> final agg.
+    """
+    from spark_rapids_tpu.ops import join as J
+    n = mesh.devices.size
+
+    def step(left: DeviceBatch, right: DeviceBatch) -> DeviceBatch:
+        lex = all_to_all_exchange(
+            left, join_partitioning_left.partition_ids(left), n, axis)
+        rex = all_to_all_exchange(
+            right, join_partitioning_right.partition_ids(right), n, axis)
+        built = J.build_side(rex, [k.ordinal
+                                   for k in join_exec.right_keys])
+        lo, counts, plive = J.probe_ranges(
+            built, lex, [k.ordinal for k in join_exec.left_keys])
+        out_cap = bucket_capacity(lex.capacity + rex.capacity)
+        p, b, valid, total = J.expand_pairs(lo, counts, out_cap,
+                                            lex.capacity)
+        probe_cols = J._gather_cols(lex, p, valid)
+        build_cols = J._gather_cols(built.batch, b, valid)
+        pairs = DeviceBatch(tuple(probe_cols) + tuple(build_cols), total)
+        partial = agg_exec._update_batch(pairs, jnp.asarray(0, jnp.int64))
+        pids = agg_partitioning.partition_ids(partial)
+        exchanged = all_to_all_exchange(partial, pids, n, axis)
+        merged = agg_exec._merge_batch(exchanged)
+        return agg_exec._finalize_batch(merged)
+
+    def wrapped(l_stacked, r_stacked):
+        left = jax.tree.map(lambda x: x[0], l_stacked)
+        right = jax.tree.map(lambda x: x[0], r_stacked)
+        out = step(left, right)
+        return jax.tree.map(lambda x: x[None], out)
+
+    sharded = shard_map(wrapped, mesh, in_specs=(P(axis), P(axis)),
+                        out_specs=P(axis))
+    return jax.jit(sharded)
+
+
+def shard_batches(mesh: Mesh, per_device: List[DeviceBatch],
+                  axis: str = DATA_AXIS) -> DeviceBatch:
+    """Assemble per-device shards into one globally-sharded DeviceBatch
+    (leaves get a leading device axis mapped onto the mesh)."""
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_device)
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
