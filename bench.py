@@ -25,16 +25,9 @@ ITERS = 5
 
 
 def make_host_batch(n_rows: int, seed: int = 0):
-    from spark_rapids_tpu.columnar import dtypes as dt
-    from spark_rapids_tpu.columnar.host import HostBatch
-    rng = np.random.default_rng(seed)
-    return HostBatch.from_pydict(
-        [("flag", dt.INT32), ("status", dt.INT32),
-         ("qty", dt.INT64), ("price", dt.FLOAT64)],
-        {"flag": rng.integers(0, 3, n_rows).tolist(),
-         "status": rng.integers(0, 2, n_rows).tolist(),
-         "qty": rng.integers(1, 50, n_rows).tolist(),
-         "price": (rng.random(n_rows) * 1000).tolist()})
+    # Shared with the driver entry so both measure the same workload.
+    import __graft_entry__ as g
+    return g.make_host_batch(n_rows, seed)
 
 
 def device_pipeline():

@@ -1,0 +1,260 @@
+"""DataFrame API frontend (SURVEY.md §7 "accept ... a direct DataFrame API
+for standalone benchmarking"; shapes mirror pyspark.sql).
+
+``TpuSession`` is the SparkSession analog: holds the conf, builds
+DataFrames from memory/files/range, and plans queries through the
+tag->convert rewrite (plan/planner.py). ``DataFrame.collect`` executes on
+the device engine with host islands where the planner tagged fallbacks;
+``DataFrame.explain`` prints the will/will-not-run-on-TPU report.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import Column, col, lit_col
+from spark_rapids_tpu.plan.planner import Planner
+
+
+class TpuSession:
+    """Session: conf + DataFrame builders (SparkSession analog)."""
+
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = C.TpuConf(conf)
+
+    # -- conf ----------------------------------------------------------------
+    def set(self, key: str, value) -> "TpuSession":
+        self.conf.set(key, value)
+        return self
+
+    # -- builders ------------------------------------------------------------
+    def create_dataframe(self, data: Union[Dict, List[tuple]],
+                         schema: Sequence[Tuple[str, dt.DataType]],
+                         num_partitions: int = 1) -> "DataFrame":
+        schema = tuple(schema)
+        if isinstance(data, dict):
+            rows = list(zip(*[data[n] for n, _ in schema])) \
+                if data else []
+        else:
+            rows = list(data)
+        per = max(1, -(-len(rows) // num_partitions)) if rows else 1
+        parts = []
+        for i in range(num_partitions):
+            chunk = rows[i * per:(i + 1) * per]
+            cols = {n: [r[ci] for r in chunk]
+                    for ci, (n, _) in enumerate(schema)}
+            parts.append([HostBatch.from_pydict(schema, cols)])
+        return DataFrame(self, L.InMemoryScan(schema, parts))
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1,
+              num_partitions: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        return DataFrame(self, L.LogicalRange(start, end, step,
+                                              num_partitions))
+
+    @property
+    def read(self) -> "DataFrameReader":
+        return DataFrameReader(self)
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self._session = session
+        self._options: Dict = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def _scan(self, fmt: str, paths) -> "DataFrame":
+        from spark_rapids_tpu.io import infer_schema
+        if isinstance(paths, str):
+            paths = [paths]
+        schema = infer_schema(fmt, paths, self._options)
+        return DataFrame(self._session,
+                         L.FileScan(fmt, list(paths), schema,
+                                    dict(self._options)))
+
+    def parquet(self, *paths) -> "DataFrame":
+        return self._scan("parquet", list(paths))
+
+    def csv(self, *paths) -> "DataFrame":
+        return self._scan("csv", list(paths))
+
+    def orc(self, *paths) -> "DataFrame":
+        return self._scan("orc", list(paths))
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[Union[str, Column]]):
+        self._df = df
+        self._keys = [(k, col(k)) if isinstance(k, str)
+                      else (k.name_hint, k) for k in keys]
+
+    def agg(self, *aggs: Column, **named: Column) -> "DataFrame":
+        specs = []
+        for a in aggs:
+            specs.append((self._agg_name(a), a))
+        for name, a in named.items():
+            specs.append((name, a))
+        plan = L.LogicalAggregate(self._df._plan, self._keys, specs)
+        return DataFrame(self._df._session, plan)
+
+    @staticmethod
+    def _agg_name(a: Column) -> str:
+        node = a.node
+        if node[0] == "alias":
+            return node[2]
+        if node[0] == "agg":
+            kind = node[1]
+            child = node[2]
+            base = child.name_hint if child is not None else "1"
+            return f"{kind}({base})"
+        return node[0]
+
+    def count(self) -> "DataFrame":
+        from spark_rapids_tpu.plan.logical import agg_count
+        return self.agg(agg_count().alias("count"))
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self._session = session
+        self._plan = plan
+
+    # -- schema ---------------------------------------------------------------
+    @property
+    def schema(self):
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return [n for n, _ in self.schema]
+
+    # -- transformations ------------------------------------------------------
+    def filter(self, condition: Column) -> "DataFrame":
+        return DataFrame(self._session,
+                         L.LogicalFilter(self._plan, condition))
+
+    where = filter
+
+    def select(self, *cols_: Union[str, Column]) -> "DataFrame":
+        projections = []
+        for c in cols_:
+            if isinstance(c, str):
+                projections.append((c, col(c)))
+            else:
+                projections.append((c.name_hint, c))
+        return DataFrame(self._session,
+                         L.LogicalProject(self._plan, projections))
+
+    def with_column(self, name: str, c: Column) -> "DataFrame":
+        # Replace in place like pyspark's withColumn; append when new.
+        if name in self.columns:
+            projections = [(n, c if n == name else col(n))
+                           for n in self.columns]
+        else:
+            projections = [(n, col(n)) for n in self.columns]
+            projections.append((name, c))
+        return DataFrame(self._session,
+                         L.LogicalProject(self._plan, projections))
+
+    withColumn = with_column
+
+    def group_by(self, *keys: Union[str, Column]) -> GroupedData:
+        return GroupedData(self, keys)
+
+    groupBy = group_by
+
+    def agg(self, *aggs: Column, **named: Column) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs, **named)
+
+    def order_by(self, *orders: Union[str, Column]) -> "DataFrame":
+        os_ = [col(o) if isinstance(o, str) else o for o in orders]
+        return DataFrame(self._session, L.LogicalSort(self._plan, os_))
+
+    orderBy = order_by
+    sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._session, L.LogicalLimit(self._plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self._session,
+                         L.LogicalUnion(self._plan, other._plan))
+
+    unionAll = union
+
+    def repartition(self, n: int, *keys: Union[str, Column]) -> "DataFrame":
+        ks = [col(k) if isinstance(k, str) else k for k in keys] or None
+        return DataFrame(self._session,
+                         L.LogicalRepartition(self._plan, n, ks))
+
+    def join(self, other: "DataFrame", on: Union[str, Sequence[str], tuple],
+             how: str = "inner", condition: Optional[Column] = None,
+             strategy: str = "auto") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        lkeys = [col(k) if isinstance(k, str) else k for k in on]
+        rkeys = list(lkeys)
+        plan = L.LogicalJoin(self._plan, other._plan, lkeys, rkeys,
+                             how, condition, strategy)
+        return DataFrame(self._session, plan)
+
+    def join_on(self, other: "DataFrame",
+                left_on: Sequence[Union[str, Column]],
+                right_on: Sequence[Union[str, Column]],
+                how: str = "inner", condition: Optional[Column] = None,
+                strategy: str = "auto") -> "DataFrame":
+        lkeys = [col(k) if isinstance(k, str) else k for k in left_on]
+        rkeys = [col(k) if isinstance(k, str) else k for k in right_on]
+        plan = L.LogicalJoin(self._plan, other._plan, lkeys, rkeys,
+                             how, condition, strategy)
+        return DataFrame(self._session, plan)
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        plan = L.LogicalJoin(self._plan, other._plan, [], [], "cross")
+        return DataFrame(self._session, plan)
+
+    crossJoin = cross_join
+
+    # -- actions --------------------------------------------------------------
+    def _physical(self):
+        return Planner(self._session.conf).plan(self._plan)
+
+    def collect(self) -> List[tuple]:
+        return self._physical().collect()
+
+    def collect_host(self) -> List[tuple]:
+        """Run entirely on the host oracle engine (CPU-Spark stand-in):
+        re-plans with sql.enabled off so no device bridges appear."""
+        import spark_rapids_tpu.config as C
+        host_conf = C.TpuConf(dict(self._session.conf.raw))
+        host_conf.set("spark.rapids.sql.enabled", False)
+        phys = Planner(host_conf).plan(self._plan)
+        from spark_rapids_tpu.ops.base import ExecContext
+        return phys.root.collect(ExecContext(host_conf), device=False)
+
+    def count_rows(self) -> int:
+        return len(self.collect())
+
+    def explain(self, mode: str = "ALL") -> str:
+        report = self._physical().explain(mode)
+        print(report)
+        return report
+
+    def to_pandas(self):
+        import pandas as pd
+        rows = self.collect()
+        return pd.DataFrame(rows, columns=self.columns)
+
+    # -- writes ---------------------------------------------------------------
+    @property
+    def write(self):
+        from spark_rapids_tpu.io.writer import DataFrameWriter
+        return DataFrameWriter(self)

@@ -1,0 +1,5 @@
+"""IO layer: file scans & writers over the arrow host-decode bridge
+(SURVEY.md §2.4 scan rows; §7 step 3)."""
+
+from spark_rapids_tpu.io.scan import (      # noqa: F401
+    FileScanExec, infer_schema, make_scan_exec)

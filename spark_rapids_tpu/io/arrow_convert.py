@@ -1,0 +1,132 @@
+"""Arrow <-> HostBatch bridge.
+
+The reference decodes file formats on the GPU through libcudf
+(Table.readParquet etc.). On TPU there is no device decoder (SURVEY.md §7
+hard-part #7 calls an on-device parquet decoder a stretch goal), so the
+design follows the reference's CPU-footer/accelerator-decode split as far
+as the platform allows: pyarrow does the host decode (columnar, vectorized
+C++), the columns convert zero-ish-copy into HostBatch numpy arrays, and
+one contiguous H2D upload per buffer puts them in HBM
+(GpuParquetScan.scala's HostMemoryBuffer -> Table.readParquet hand-off).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+
+import pyarrow as pa
+
+
+_ARROW_TO_DT = {
+    pa.bool_(): dt.BOOL,
+    pa.int8(): dt.INT8,
+    pa.int16(): dt.INT16,
+    pa.int32(): dt.INT32,
+    pa.int64(): dt.INT64,
+    pa.float32(): dt.FLOAT32,
+    pa.float64(): dt.FLOAT64,
+    pa.date32(): dt.DATE,
+    pa.string(): dt.STRING,
+    pa.large_string(): dt.STRING,
+    pa.binary(): dt.STRING,
+}
+
+
+def arrow_type_to_dt(t: pa.DataType) -> DataType:
+    if t in _ARROW_TO_DT:
+        return _ARROW_TO_DT[t]
+    if pa.types.is_timestamp(t):
+        return dt.TIMESTAMP
+    if pa.types.is_dictionary(t):
+        return arrow_type_to_dt(t.value_type)
+    raise TypeError(f"unsupported arrow type {t} "
+                    "(supported: bool/int/float/date/timestamp/string)")
+
+
+def dt_to_arrow_type(t: DataType) -> pa.DataType:
+    if t.is_string:
+        return pa.string()
+    if t.name == "date":
+        return pa.date32()
+    if t.name == "timestamp":
+        return pa.timestamp("us", tz="UTC")
+    return pa.from_numpy_dtype(t.np_dtype)
+
+
+def schema_from_arrow(sch: pa.Schema) -> Tuple[Tuple[str, DataType], ...]:
+    return tuple((f.name, arrow_type_to_dt(f.type)) for f in sch)
+
+
+def arrow_to_host_batch(table: "pa.Table",
+                        schema: Optional[Sequence] = None) -> HostBatch:
+    """One arrow table/record-batch -> HostBatch."""
+    if isinstance(table, pa.RecordBatch):
+        table = pa.Table.from_batches([table])
+    table = table.combine_chunks()
+    names = []
+    cols: List[HostColumn] = []
+    for ci, field in enumerate(table.schema):
+        t = arrow_type_to_dt(field.type)
+        arr = table.column(ci)
+        chunk = arr.chunk(0) if arr.num_chunks else pa.array(
+            [], type=field.type)
+        if pa.types.is_dictionary(chunk.type):
+            chunk = chunk.dictionary_decode()
+        n = len(chunk)
+        validity = np.asarray(chunk.is_valid())
+        if t.is_string:
+            data = np.empty(n, dtype=object)
+            pyvals = chunk.to_pylist()
+            for i, v in enumerate(pyvals):
+                if v is None:
+                    data[i] = b""
+                else:
+                    data[i] = v.encode("utf-8") if isinstance(v, str) \
+                        else bytes(v)
+        elif t.name == "timestamp":
+            # Arrow timestamps may be s/ms/us/ns; normalize to us.
+            c = chunk.cast(pa.timestamp("us"))
+            data = np.asarray(c.cast(pa.int64()).fill_null(0),
+                              dtype=np.int64)
+        elif t.name == "date":
+            data = np.asarray(chunk.cast(pa.int32()).fill_null(0),
+                              dtype=np.int32)
+        else:
+            data = np.asarray(chunk.fill_null(0)).astype(t.np_dtype)
+        names.append(field.name)
+        cols.append(HostColumn(t, data, validity))
+    return HostBatch(tuple(names), cols)
+
+
+def host_batch_to_arrow(hb: HostBatch) -> "pa.Table":
+    arrays = []
+    fields = []
+    for name, c in zip(hb.names, hb.columns):
+        at = dt_to_arrow_type(c.dtype)
+        vals = c.to_list()
+        if c.dtype.name == "timestamp":
+            arr = pa.array(
+                [None if v is None else int(v) for v in
+                 _raw_vals(c)], type=pa.int64()).cast(at)
+        elif c.dtype.name == "date":
+            arr = pa.array(
+                [None if v is None else int(v) for v in _raw_vals(c)],
+                type=pa.int32()).cast(at)
+        else:
+            arr = pa.array(vals, type=at)
+        arrays.append(arr)
+        fields.append(pa.field(name, at))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+def _raw_vals(c: HostColumn):
+    out = []
+    for i in range(c.num_rows):
+        out.append(None if not c.validity[i] else c.data[i])
+    return out

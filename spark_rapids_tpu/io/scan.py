@@ -1,0 +1,207 @@
+"""File scans: parquet / ORC / CSV (ref: GpuParquetScan.scala:84,
+GpuOrcScan.scala, GpuBatchScanExec.scala CSV path).
+
+Reader strategies mirror RapidsConf's
+``spark.rapids.sql.format.parquet.reader.type`` (RapidsConf.scala:510):
+- PERFILE: open and decode one file at a time, upload per batch.
+- MULTITHREADED: a host thread pool prefetches+decodes files in the
+  background while the device consumes earlier ones — the
+  MultiFileCloudParquetPartitionReader overlap (GpuParquetScan.scala:1144).
+- COALESCING: decode several files and concatenate their rows into fewer,
+  larger device batches (MultiFileParquetPartitionReader:823's
+  stitch-row-groups idea at the arrow level).
+- AUTO: MULTITHREADED (the cloud default heuristic).
+
+Partitioning: files are distributed round-robin over N partitions
+(one Spark task per file-chunk analog). Row-group-level splits are handled
+inside pyarrow's batch iteration.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch, host_to_device
+from spark_rapids_tpu.ops.base import Exec, ExecContext, LeafExec, Schema, \
+    timed
+from spark_rapids_tpu.io.arrow_convert import (
+    arrow_to_host_batch, schema_from_arrow)
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+
+def infer_schema(fmt: str, paths: Sequence[str], options: Dict) -> Schema:
+    """Footer/header-only schema inference (CPU-side footer parse, the
+    GpuParquetScan footer-on-CPU half)."""
+    path = paths[0]
+    if fmt == "parquet":
+        return schema_from_arrow(papq.ParquetFile(path).schema_arrow)
+    if fmt == "orc":
+        return schema_from_arrow(paorc.ORCFile(path).schema)
+    if fmt == "csv":
+        # Stream only the first block to infer types (no full-file parse).
+        read_opts = _csv_read_options(options, sample=True)
+        with pacsv.open_csv(path, **read_opts) as reader:
+            return schema_from_arrow(reader.schema)
+    raise ValueError(f"unknown format {fmt}")
+
+
+def _csv_read_options(options: Dict, sample: bool = False):
+    kwargs = {}
+    parse = pacsv.ParseOptions(
+        delimiter=options.get("sep", options.get("delimiter", ",")))
+    has_header = str(options.get("header", "true")).lower() in (
+        "true", "1", "yes")
+    read_kwargs = {"autogenerate_column_names": not has_header}
+    if sample:
+        read_kwargs["block_size"] = 1 << 20   # schema from first 1MB only
+    kwargs["parse_options"] = parse
+    kwargs["read_options"] = pacsv.ReadOptions(**read_kwargs)
+    return kwargs
+
+
+def _read_file_batches(fmt: str, path: str, options: Dict,
+                       batch_rows: int) -> Iterator[HostBatch]:
+    if fmt == "parquet":
+        pf = papq.ParquetFile(path)
+        for rb in pf.iter_batches(batch_size=batch_rows):
+            yield arrow_to_host_batch(rb)
+    elif fmt == "orc":
+        f = paorc.ORCFile(path)
+        for si in range(f.nstripes):
+            yield arrow_to_host_batch(f.read_stripe(si))
+    elif fmt == "csv":
+        tbl = pacsv.read_csv(path, **_csv_read_options(options))
+        for rb in tbl.to_batches(max_chunksize=batch_rows):
+            yield arrow_to_host_batch(rb)
+    else:
+        raise ValueError(fmt)
+
+
+class FileScanExec(LeafExec):
+    """Leaf scan over N files in a format, with reader strategies."""
+
+    def __init__(self, fmt: str, paths: Sequence[str], schema: Schema,
+                 options: Optional[Dict] = None,
+                 num_partitions: Optional[int] = None):
+        super().__init__()
+        self.fmt = fmt
+        self.paths = list(paths)
+        self._schema = tuple(schema)
+        self.options = dict(options or {})
+        self._parts = num_partitions or min(len(self.paths), 8) or 1
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    @property
+    def name(self) -> str:
+        return f"{type(self).__name__}[{self.fmt}]"
+
+    def num_partitions(self, ctx) -> int:
+        return self._parts
+
+    def _files_of(self, partition: int) -> List[str]:
+        return [p for i, p in enumerate(self.paths)
+                if i % self._parts == partition]
+
+    def _reader_type(self, ctx) -> str:
+        rt = str(ctx.conf.get(C.PARQUET_READER_TYPE)).upper()
+        if rt == "AUTO":
+            return "MULTITHREADED"
+        return rt
+
+    def _batch_rows(self, ctx) -> int:
+        return int(ctx.conf.get(C.MAX_READER_BATCH_SIZE_ROWS))
+
+    # -- host engine ---------------------------------------------------------
+    def execute_host(self, ctx, partition):
+        rows = self._batch_rows(ctx)
+        for path in self._files_of(partition):
+            yield from _read_file_batches(self.fmt, path, self.options,
+                                          rows)
+
+    # -- device engine -------------------------------------------------------
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        rt = self._reader_type(ctx)
+        rows = self._batch_rows(ctx)
+        files = self._files_of(partition)
+        if rt == "MULTITHREADED":
+            yield from self._device_multithreaded(ctx, m, files, rows)
+            return
+        if rt == "COALESCING":
+            yield from self._device_coalescing(ctx, m, files, rows)
+            return
+        for path in files:   # PERFILE
+            for hb in _read_file_batches(self.fmt, path, self.options,
+                                         rows):
+                with timed(m, "bufferTime"):
+                    batch = host_to_device(hb)
+                m.add("numOutputBatches", 1)
+                yield batch
+
+    def _device_multithreaded(self, ctx, m, files, rows):
+        """Background host decode overlapped with device consumption
+        (MultiFileCloudParquetPartitionReader's thread-pool overlap)."""
+        nthreads = int(ctx.conf.get(
+            C.PARQUET_MULTITHREADED_READ_NUM_THREADS))
+        with concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(nthreads, max(len(files), 1))) as pool:
+            futures = [
+                pool.submit(lambda p=p: list(_read_file_batches(
+                    self.fmt, p, self.options, rows)))
+                for p in files]
+            for fut in futures:
+                for hb in fut.result():
+                    with timed(m, "bufferTime"):
+                        batch = host_to_device(hb)
+                    m.add("numOutputBatches", 1)
+                    yield batch
+
+    def _device_coalescing(self, ctx, m, files, rows):
+        """Concatenate small files' rows into fewer, larger uploads."""
+        pending: List[HostBatch] = []
+        pending_rows = 0
+        for path in files:
+            for hb in _read_file_batches(self.fmt, path, self.options,
+                                         rows):
+                pending.append(hb)
+                pending_rows += hb.num_rows
+                if pending_rows >= rows:
+                    yield self._upload_merged(m, pending)
+                    pending, pending_rows = [], 0
+        if pending:
+            yield self._upload_merged(m, pending)
+
+    def _upload_merged(self, m, hbs: List[HostBatch]):
+        from spark_rapids_tpu.columnar.host import HostColumn
+        if len(hbs) == 1:
+            merged = hbs[0]
+        else:
+            cols = []
+            for ci, c0 in enumerate(hbs[0].columns):
+                data = np.concatenate([hb.columns[ci].data for hb in hbs])
+                val = np.concatenate([hb.columns[ci].validity
+                                      for hb in hbs])
+                cols.append(HostColumn(c0.dtype, data, val))
+            merged = HostBatch(hbs[0].names, cols)
+        with timed(m, "bufferTime"):
+            batch = host_to_device(merged)
+        m.add("numOutputBatches", 1)
+        return batch
+
+
+def make_scan_exec(file_scan, conf) -> FileScanExec:
+    """Planner hook for L.FileScan nodes."""
+    return FileScanExec(file_scan.fmt, file_scan.paths,
+                        file_scan.source_schema, file_scan.options)

@@ -1,0 +1,104 @@
+"""Columnar writers: parquet / ORC / CSV output (ref:
+GpuParquetFileFormat.scala + ColumnarOutputWriter.scala +
+GpuFileFormatWriter.scala's per-partition files).
+
+Each engine partition writes one ``part-NNNNN`` file inside the output
+directory (Spark's directory-of-parts layout), chunked through arrow
+writers (Table.writeParquetChunked analog).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from spark_rapids_tpu.columnar.host import HostBatch, device_to_host
+from spark_rapids_tpu.io.arrow_convert import host_batch_to_arrow
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.orc as paorc
+import pyarrow.parquet as papq
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self._df = df
+        self._options: Dict = {}
+        self._mode = "error"
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def _prepare_dir(self, path: str):
+        if os.path.exists(path):
+            if self._mode == "overwrite":
+                import shutil
+                shutil.rmtree(path)
+            elif self._mode == "error":
+                raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+
+    def _write(self, path: str, fmt: str):
+        import uuid
+        from spark_rapids_tpu.ops.base import ExecContext
+        self._prepare_dir(path)
+        phys = self._df._physical()
+        ctx = ExecContext(self._df._session.conf)
+        root = phys.root
+        names = tuple(n for n, _ in root.schema)
+        n_parts = root.num_partitions(ctx)
+        # Unique job id in file names so append mode never clobbers a
+        # previous write's parts (Spark's write-uuid naming).
+        job = uuid.uuid4().hex[:8]
+        for p in range(n_parts):
+            out = os.path.join(path, f"part-{p:05d}-{job}.{fmt}")
+            writer = None
+            wrote = False
+            for b in (root.execute_device(ctx, p) if phys.root_on_device
+                      else root.execute_host(ctx, p)):
+                hb = device_to_host(b, names) if phys.root_on_device else b
+                if hb.num_rows == 0 and wrote:
+                    continue
+                table = host_batch_to_arrow(hb)
+                if fmt == "parquet":
+                    if writer is None:
+                        writer = papq.ParquetWriter(out, table.schema)
+                    writer.write_table(table)
+                elif fmt == "orc":
+                    if writer is None:
+                        writer = paorc.ORCWriter(out)
+                    writer.write(table)
+                elif fmt == "csv":
+                    if writer is None:
+                        writer = pacsv.CSVWriter(out, table.schema)
+                    writer.write(table)
+                wrote = True
+            if writer is not None:
+                writer.close()
+            elif not wrote:
+                # Empty partition still writes schema-only file (parquet).
+                if fmt == "parquet":
+                    empty = host_batch_to_arrow(
+                        _empty_host_batch(root.schema))
+                    papq.write_table(empty, out)
+
+    def parquet(self, path: str):
+        self._write(path, "parquet")
+
+    def orc(self, path: str):
+        self._write(path, "orc")
+
+    def csv(self, path: str):
+        self._write(path, "csv")
+
+
+def _empty_host_batch(schema) -> HostBatch:
+    from spark_rapids_tpu.columnar.host import HostColumn
+    return HostBatch(tuple(n for n, _ in schema),
+                     [HostColumn.from_values(t, []) for _, t in schema])

@@ -111,16 +111,23 @@ def expand_pairs(lo: jnp.ndarray, counts: jnp.ndarray, out_cap: int,
 
     offsets = exclusive cumsum(counts); slot s belongs to probe row
     p = upper_bound(offsets, s) - 1 and build row lo[p] + (s - offsets[p]).
+
+    Returns (p, b, valid, num_rows, overflowed): when the true pair count
+    exceeds ``out_cap`` (callers that cannot sync the exact total, e.g. the
+    mesh-collective join), num_rows clamps to out_cap and ``overflowed``
+    flags the truncation so callers can surface it instead of silently
+    dropping pairs.
     """
     offsets = jnp.cumsum(counts) - counts          # exclusive
     total = jnp.sum(counts)
+    num_rows = jnp.minimum(total, out_cap).astype(jnp.int32)
     slots = jnp.arange(out_cap, dtype=jnp.int32)
     p = (jnp.searchsorted(offsets, slots, side="right") - 1).astype(jnp.int32)
     p = jnp.clip(p, 0, probe_cap - 1)
     within = slots - jnp.take(offsets, p, axis=0)
     b = jnp.take(lo, p, axis=0) + within.astype(jnp.int32)
-    valid = slots < total
-    return p, b, valid, total
+    valid = slots < num_rows
+    return p, b, valid, num_rows, total > out_cap
 
 
 def _gather_cols(batch: DeviceBatch, rows: jnp.ndarray,
@@ -189,7 +196,8 @@ class _JoinKernelMixin:
         jt = self.join_type
         cond = self.condition
         probe_cap = pbatch.capacity
-        p, b, valid, total = expand_pairs(lo, counts, out_cap, probe_cap)
+        p, b, valid, total, _overflow = expand_pairs(lo, counts, out_cap,
+                                                     probe_cap)
         probe_cols = _gather_cols(pbatch, p, valid)
         build_cols = _gather_cols(built.batch, b, valid)
         if build_is_right:
@@ -466,7 +474,8 @@ class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
         cond = self.condition
         probe_cap = pbatch.capacity
         bcap = built.batch.capacity
-        p, b, valid, total = expand_pairs(lo, counts, out_cap, probe_cap)
+        p, b, valid, total, _overflow = expand_pairs(lo, counts, out_cap,
+                                                     probe_cap)
         left_cols = _gather_cols(pbatch, p, valid)
         right_cols = _gather_cols(built.batch, b, valid)
         pairs = DeviceBatch(tuple(left_cols) + tuple(right_cols), total)

@@ -130,16 +130,23 @@ def distributed_join_agg_step(mesh: Mesh, join_exec, agg_exec,
                               join_partitioning_left,
                               join_partitioning_right,
                               agg_partitioning,
-                              axis: str = DATA_AXIS):
+                              axis: str = DATA_AXIS,
+                              join_out_capacity: Optional[int] = None):
     """Distributed join + aggregate step (TPC-H q3-shaped):
 
     per device: all_to_all both sides by join key -> local hash join ->
     partial agg -> all_to_all by group key -> final agg.
+
+    Returns (result, overflowed): a join can emit up to |L|x|R| pairs per
+    device; ``join_out_capacity`` bounds the static expansion buffer
+    (default: the exact |L|x|R| product when small, else 4x the input).
+    ``overflowed`` is a per-device bool — callers must check it, since
+    pairs beyond the capacity are truncated.
     """
     from spark_rapids_tpu.ops import join as J
     n = mesh.devices.size
 
-    def step(left: DeviceBatch, right: DeviceBatch) -> DeviceBatch:
+    def step(left: DeviceBatch, right: DeviceBatch):
         lex = all_to_all_exchange(
             left, join_partitioning_left.partition_ids(left), n, axis)
         rex = all_to_all_exchange(
@@ -148,23 +155,28 @@ def distributed_join_agg_step(mesh: Mesh, join_exec, agg_exec,
                                    for k in join_exec.right_keys])
         lo, counts, plive = J.probe_ranges(
             built, lex, [k.ordinal for k in join_exec.left_keys])
-        out_cap = bucket_capacity(lex.capacity + rex.capacity)
-        p, b, valid, total = J.expand_pairs(lo, counts, out_cap,
-                                            lex.capacity)
+        if join_out_capacity is not None:
+            out_cap = bucket_capacity(join_out_capacity)
+        elif lex.capacity * rex.capacity <= (1 << 20):
+            out_cap = bucket_capacity(lex.capacity * rex.capacity)
+        else:
+            out_cap = bucket_capacity(4 * (lex.capacity + rex.capacity))
+        p, b, valid, num_rows, overflow = J.expand_pairs(
+            lo, counts, out_cap, lex.capacity)
         probe_cols = J._gather_cols(lex, p, valid)
         build_cols = J._gather_cols(built.batch, b, valid)
-        pairs = DeviceBatch(tuple(probe_cols) + tuple(build_cols), total)
+        pairs = DeviceBatch(tuple(probe_cols) + tuple(build_cols), num_rows)
         partial = agg_exec._update_batch(pairs, jnp.asarray(0, jnp.int64))
         pids = agg_partitioning.partition_ids(partial)
         exchanged = all_to_all_exchange(partial, pids, n, axis)
         merged = agg_exec._merge_batch(exchanged)
-        return agg_exec._finalize_batch(merged)
+        return agg_exec._finalize_batch(merged), overflow
 
     def wrapped(l_stacked, r_stacked):
         left = jax.tree.map(lambda x: x[0], l_stacked)
         right = jax.tree.map(lambda x: x[0], r_stacked)
-        out = step(left, right)
-        return jax.tree.map(lambda x: x[None], out)
+        out, overflow = step(left, right)
+        return (jax.tree.map(lambda x: x[None], out), overflow[None])
 
     sharded = shard_map(wrapped, mesh, in_specs=(P(axis), P(axis)),
                         out_specs=P(axis))

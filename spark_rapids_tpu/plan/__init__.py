@@ -1,0 +1,6 @@
+"""Plan layer: logical plans, tag->convert rewrite, transitions
+(SURVEY.md §1 L6)."""
+
+from spark_rapids_tpu.plan.logical import (     # noqa: F401
+    Column, LogicalPlan, col, lit_col, resolve)
+from spark_rapids_tpu.plan.planner import Planner, PhysicalPlan  # noqa: F401

@@ -1,0 +1,537 @@
+"""Logical plans and the untyped column DSL.
+
+The reference rewrites Spark physical plans in place (GpuOverrides over
+SparkPlan). Standalone, this engine owns the frontend too, so the input to
+the plan-rewrite layer is this logical plan — built by the DataFrame API
+(api/dataframe.py) — with unresolved, name-based expressions. ``resolve``
+binds names to ordinals and picks typed expression classes
+(exprs/*), the analog of Catalyst analysis feeding GpuOverrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu import exprs as E
+from spark_rapids_tpu.exprs.base import BoundReference, Expression, Literal
+
+Schema = Tuple[Tuple[str, DataType], ...]
+
+
+# ---------------------------------------------------------------------------
+# Untyped column AST (the DataFrame DSL)
+# ---------------------------------------------------------------------------
+
+class Column:
+    """Unresolved expression node; operators build the AST lazily."""
+
+    def __init__(self, node: Tuple):
+        self.node = node
+
+    # -- operators -----------------------------------------------------------
+    def _bin(self, op: str, other) -> "Column":
+        return Column((op, self, _as_col(other)))
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    def __radd__(self, o):
+        return _as_col(o)._bin("add", self)
+
+    def __sub__(self, o):
+        return self._bin("sub", o)
+
+    def __rsub__(self, o):
+        return _as_col(o)._bin("sub", self)
+
+    def __mul__(self, o):
+        return self._bin("mul", o)
+
+    def __rmul__(self, o):
+        return _as_col(o)._bin("mul", self)
+
+    def __truediv__(self, o):
+        return self._bin("div", o)
+
+    def __mod__(self, o):
+        return self._bin("mod", o)
+
+    def __neg__(self):
+        return Column(("neg", self))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("eq", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Column(("not", self._bin("eq", o)))
+
+    def __lt__(self, o):
+        return self._bin("lt", o)
+
+    def __le__(self, o):
+        return self._bin("le", o)
+
+    def __gt__(self, o):
+        return self._bin("gt", o)
+
+    def __ge__(self, o):
+        return self._bin("ge", o)
+
+    def __and__(self, o):
+        return self._bin("and", o)
+
+    def __or__(self, o):
+        return self._bin("or", o)
+
+    def __invert__(self):
+        return Column(("not", self))
+
+    def __hash__(self):
+        return id(self)
+
+    # -- named helpers --------------------------------------------------------
+    def alias(self, name: str) -> "Column":
+        return Column(("alias", self, name))
+
+    def cast(self, to: Union[str, DataType]) -> "Column":
+        t = dt.type_named(to) if isinstance(to, str) else to
+        return Column(("cast", self, t))
+
+    def isNull(self) -> "Column":
+        return Column(("isnull", self))
+
+    def isNotNull(self) -> "Column":
+        return Column(("isnotnull", self))
+
+    def isin(self, *values) -> "Column":
+        vals = values[0] if len(values) == 1 and \
+            isinstance(values[0], (list, tuple)) else values
+        return Column(("isin", self, tuple(vals)))
+
+    def substr(self, pos, length) -> "Column":
+        return Column(("substr", self, _as_col(pos), _as_col(length)))
+
+    def startswith(self, s: str) -> "Column":
+        return Column(("startswith", self, s))
+
+    def endswith(self, s: str) -> "Column":
+        return Column(("endswith", self, s))
+
+    def contains(self, s: str) -> "Column":
+        return Column(("contains", self, s))
+
+    def like(self, pattern: str) -> "Column":
+        return Column(("like", self, pattern))
+
+    def rlike_replace(self, pattern: str, repl: str) -> "Column":
+        return Column(("regexp_replace", self, pattern, repl))
+
+    def asc(self) -> "Column":
+        return Column(("sortorder", self, True, True))
+
+    def desc(self) -> "Column":
+        return Column(("sortorder", self, False, False))
+
+    @property
+    def name_hint(self) -> str:
+        n = self.node
+        if n[0] == "ref":
+            return n[1]
+        if n[0] == "alias":
+            return n[2]
+        return n[0]
+
+
+def col(name: str) -> Column:
+    return Column(("ref", name))
+
+
+def lit_col(value) -> Column:
+    return Column(("lit", value))
+
+
+def _as_col(v) -> Column:
+    if isinstance(v, Column):
+        return v
+    return lit_col(v)
+
+
+# Free functions mirroring pyspark.sql.functions.
+def upper(c: Column) -> Column:
+    return Column(("upper", _as_col(c)))
+
+
+def lower(c: Column) -> Column:
+    return Column(("lower", _as_col(c)))
+
+
+def length(c: Column) -> Column:
+    return Column(("length", _as_col(c)))
+
+
+def concat(*cs) -> Column:
+    return Column(("concat", tuple(_as_col(c) for c in cs)))
+
+
+def coalesce_cols(*cs) -> Column:
+    return Column(("coalesce", tuple(_as_col(c) for c in cs)))
+
+
+def when(cond: Column, value) -> "WhenBuilder":
+    return WhenBuilder([(cond, _as_col(value))])
+
+
+class WhenBuilder(Column):
+    def __init__(self, branches):
+        self.branches = branches
+        super().__init__(("when", tuple(branches), None))
+
+    def when(self, cond: Column, value) -> "WhenBuilder":
+        return WhenBuilder(self.branches + [(cond, _as_col(value))])
+
+    def otherwise(self, value) -> Column:
+        return Column(("when", tuple(self.branches), _as_col(value)))
+
+
+def year(c):
+    return Column(("year", _as_col(c)))
+
+
+def month(c):
+    return Column(("month", _as_col(c)))
+
+
+def dayofmonth(c):
+    return Column(("dayofmonth", _as_col(c)))
+
+
+def sqrt_col(c):
+    return Column(("sqrt", _as_col(c)))
+
+
+def abs_col(c):
+    return Column(("abs", _as_col(c)))
+
+
+def round_col(c, scale=0):
+    return Column(("round", _as_col(c), scale))
+
+
+def murmur3_hash(*cs):
+    return Column(("hash", tuple(_as_col(c) for c in cs)))
+
+
+# Aggregate builders.
+def agg_sum(c) -> Column:
+    return Column(("agg", "sum", _as_col(c)))
+
+
+def agg_count(c=None) -> Column:
+    return Column(("agg", "count", None if c is None else _as_col(c)))
+
+
+def agg_min(c) -> Column:
+    return Column(("agg", "min", _as_col(c)))
+
+
+def agg_max(c) -> Column:
+    return Column(("agg", "max", _as_col(c)))
+
+
+def agg_avg(c) -> Column:
+    return Column(("agg", "avg", _as_col(c)))
+
+
+def agg_first(c, ignore_nulls=True) -> Column:
+    return Column(("agg", "first", _as_col(c), ignore_nulls))
+
+
+def agg_last(c, ignore_nulls=True) -> Column:
+    return Column(("agg", "last", _as_col(c), ignore_nulls))
+
+
+# ---------------------------------------------------------------------------
+# Expression resolution (name -> ordinal, untyped -> typed)
+# ---------------------------------------------------------------------------
+
+class ResolutionError(ValueError):
+    pass
+
+
+def resolve(c: Column, schema: Schema) -> Expression:
+    """Bind an untyped Column AST against a schema."""
+    node = c.node
+    kind = node[0]
+    names = [n for n, _ in schema]
+
+    def rec(x):
+        return resolve(x, schema)
+
+    if kind == "ref":
+        name = node[1]
+        if name not in names:
+            raise ResolutionError(
+                f"column {name!r} not in {names}")
+        i = names.index(name)
+        return BoundReference(i, schema[i][1], name)
+    if kind == "lit":
+        v = node[1]
+        if v is None:
+            raise ResolutionError("untyped NULL literal; use typed lit")
+        return E.lit(v)
+    if kind == "alias":
+        return rec(node[1])
+    if kind == "cast":
+        return E.Cast(rec(node[1]), node[2])
+    if kind == "neg":
+        return E.UnaryMinus(rec(node[1]))
+    if kind == "not":
+        return E.Not(rec(node[1]))
+    if kind in ("add", "sub", "mul", "div", "mod", "eq", "lt", "le", "gt",
+                "ge", "and", "or"):
+        l, r = rec(node[1]), rec(node[2])
+        l, r = _coerce_pair(l, r)
+        table = {
+            "add": E.Add, "sub": E.Subtract, "mul": E.Multiply,
+            "div": E.Divide, "mod": E.Remainder, "eq": E.EqualTo,
+            "lt": E.LessThan, "le": E.LessThanOrEqual, "gt": E.GreaterThan,
+            "ge": E.GreaterThanOrEqual, "and": E.And, "or": E.Or,
+        }
+        return table[kind](l, r)
+    if kind == "isnull":
+        return E.IsNull(rec(node[1]))
+    if kind == "isnotnull":
+        return E.IsNotNull(rec(node[1]))
+    if kind == "isin":
+        return E.InSet(rec(node[1]), node[2])
+    if kind == "substr":
+        return E.Substring(rec(node[1]), rec(node[2]), rec(node[3]))
+    if kind == "startswith":
+        return E.StartsWith(rec(node[1]), E.lit(node[2]))
+    if kind == "endswith":
+        return E.EndsWith(rec(node[1]), E.lit(node[2]))
+    if kind == "contains":
+        return E.Contains(rec(node[1]), E.lit(node[2]))
+    if kind == "like":
+        return E.Like(rec(node[1]), node[2])
+    if kind == "regexp_replace":
+        return E.RegExpReplace(rec(node[1]), node[2], node[3])
+    if kind == "upper":
+        return E.Upper(rec(node[1]))
+    if kind == "lower":
+        return E.Lower(rec(node[1]))
+    if kind == "length":
+        return E.Length(rec(node[1]))
+    if kind == "concat":
+        return E.ConcatStrings(*[rec(x) for x in node[1]])
+    if kind == "coalesce":
+        return E.Coalesce(*[rec(x) for x in node[1]])
+    if kind == "when":
+        branches = [(rec(cond), rec(val)) for cond, val in node[1]]
+        else_e = rec(node[2]) if node[2] is not None else None
+        return E.CaseWhen(branches, else_e)
+    if kind == "year":
+        return E.Year(rec(node[1]))
+    if kind == "month":
+        return E.Month(rec(node[1]))
+    if kind == "dayofmonth":
+        return E.DayOfMonth(rec(node[1]))
+    if kind == "sqrt":
+        return E.Sqrt(rec(node[1]))
+    if kind == "abs":
+        return E.Abs(rec(node[1]))
+    if kind == "round":
+        return E.Round(rec(node[1]), node[2])
+    if kind == "hash":
+        return E.Murmur3Hash([rec(x) for x in node[1]])
+    if kind == "sortorder":
+        raise ResolutionError("sort order only valid in orderBy")
+    raise ResolutionError(f"cannot resolve expression kind {kind!r}")
+
+
+def _coerce_pair(l: Expression, r: Expression):
+    """Numeric literal widening so col(int32) == lit(5) type-checks."""
+    lt, rt = l.data_type(), r.data_type()
+    if lt == rt:
+        return l, r
+    if lt.is_numeric and rt.is_numeric:
+        return l, r   # binary templates widen internally
+    if lt.is_datetime and rt.is_integral:
+        return l, r
+    if rt.is_datetime and lt.is_integral:
+        return l, r
+    if lt.is_string != rt.is_string:
+        # cast the non-string side to string? Spark casts literals; keep
+        # strict here — casts must be explicit.
+        raise ResolutionError(f"type mismatch: {lt} vs {rt}")
+    return l, r
+
+
+# ---------------------------------------------------------------------------
+# Logical plan nodes
+# ---------------------------------------------------------------------------
+
+class LogicalPlan:
+    children: Tuple["LogicalPlan", ...] = ()
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class InMemoryScan(LogicalPlan):
+    source_schema: Schema
+    partitions: list            # List[List[HostBatch]]
+    children = ()
+
+    @property
+    def schema(self) -> Schema:
+        return self.source_schema
+
+
+@dataclasses.dataclass
+class FileScan(LogicalPlan):
+    fmt: str                    # parquet | csv | orc
+    paths: list
+    source_schema: Schema
+    options: dict
+    children = ()
+
+    @property
+    def schema(self) -> Schema:
+        return self.source_schema
+
+
+@dataclasses.dataclass
+class LogicalRange(LogicalPlan):
+    start: int
+    end: int
+    step: int
+    num_partitions: int
+    children = ()
+
+    @property
+    def schema(self) -> Schema:
+        return (("id", dt.INT64),)
+
+
+class _Unary(LogicalPlan):
+    def __init__(self, child: LogicalPlan):
+        self.children = (child,)
+
+    @property
+    def child(self) -> LogicalPlan:
+        return self.children[0]
+
+
+class LogicalFilter(_Unary):
+    def __init__(self, child, condition: Column):
+        super().__init__(child)
+        self.condition = condition
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalProject(_Unary):
+    def __init__(self, child, projections: Sequence[Tuple[str, Column]]):
+        super().__init__(child)
+        self.projections = list(projections)
+
+    @property
+    def schema(self) -> Schema:
+        out = []
+        for name, c in self.projections:
+            e = resolve(c, self.child.schema)
+            out.append((name, e.data_type()))
+        return tuple(out)
+
+
+class LogicalAggregate(_Unary):
+    def __init__(self, child, group_by: Sequence[Tuple[str, Column]],
+                 aggregates: Sequence[Tuple[str, Column]]):
+        super().__init__(child)
+        self.group_by = list(group_by)
+        self.aggregates = list(aggregates)
+
+    @property
+    def schema(self) -> Schema:
+        from spark_rapids_tpu.plan.planner import resolve_agg
+        out = []
+        for name, c in self.group_by:
+            out.append((name, resolve(c, self.child.schema).data_type()))
+        for name, c in self.aggregates:
+            fn = resolve_agg(c, self.child.schema)
+            out.append((name, fn.result_type))
+        return tuple(out)
+
+
+class LogicalSort(_Unary):
+    def __init__(self, child, orders: Sequence[Column]):
+        super().__init__(child)
+        self.orders = list(orders)
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalLimit(_Unary):
+    def __init__(self, child, n: int):
+        super().__init__(child)
+        self.n = n
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalRepartition(_Unary):
+    def __init__(self, child, num_partitions: int,
+                 keys: Optional[Sequence[Column]] = None):
+        super().__init__(child)
+        self.num_partitions = num_partitions
+        self.keys = list(keys) if keys else None
+
+    @property
+    def schema(self) -> Schema:
+        return self.child.schema
+
+
+class LogicalUnion(LogicalPlan):
+    def __init__(self, *children: LogicalPlan):
+        self.children = tuple(children)
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+
+class LogicalJoin(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Column], right_keys: Sequence[Column],
+                 join_type: str = "inner",
+                 condition: Optional[Column] = None,
+                 strategy: str = "auto"):
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type
+        self.condition = condition
+        self.strategy = strategy    # auto | broadcast | shuffle
+
+    @property
+    def schema(self) -> Schema:
+        if self.join_type in ("semi", "anti"):
+            return self.children[0].schema
+        return tuple(self.children[0].schema) + \
+            tuple(self.children[1].schema)

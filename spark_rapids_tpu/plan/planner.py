@@ -1,0 +1,409 @@
+"""Plan rewrite: wrap -> tag -> convert (ref: GpuOverrides.scala:1991,
+RapidsMeta.scala:189, GpuTransitionOverrides.scala).
+
+The reference's crown jewel, rebuilt for the standalone engine:
+- every logical node and expression is wrapped in a Meta carrying
+  fallback ``reasons`` (RapidsMeta.willNotWorkOnGpu analog);
+- per-node kill-switch configs are auto-registered
+  (``spark.rapids.sql.exec.<Node>`` / ``spark.rapids.sql.expression.<Kind>``
+  — RapidsMeta confKey, SURVEY.md §5.6);
+- incompat expressions (locale-sensitive case mapping, order-dependent
+  float aggregation) fall back to the host engine unless
+  ``spark.rapids.sql.incompatibleOps.enabled`` (GpuOverrides incompat
+  flags);
+- conversion emits the physical Exec tree with explicit
+  HostToDevice/DeviceToHost transitions at placement changes
+  (GpuTransitionOverrides insertColumnarToGpu/FromGpu), two-stage
+  aggregation across hash exchanges, range exchanges under global sorts,
+  and broadcast-vs-shuffle join planning;
+- ``explain`` renders the will/will-not-run report
+  (RapidsMeta.explain:291), and test mode
+  ``spark.rapids.sql.test.enabled`` fails any query with a
+  non-allowlisted host node (GpuTransitionOverrides.assertIsOnTheGpu:391).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu import exprs as E
+from spark_rapids_tpu.exprs.base import BoundReference, Expression
+from spark_rapids_tpu.ops import (
+    AggSpec, Average, Count, CountStar, ExpandExec, FilterExec, First,
+    GlobalLimitExec, HashAggregateExec, Last, LocalLimitExec, Max, Min,
+    ProjectExec, RangeExec, SortExec, SortOrder, Sum, UnionExec)
+from spark_rapids_tpu.ops.base import (
+    DeviceToHostExec, Exec, HostToDeviceExec, InMemorySourceExec)
+from spark_rapids_tpu.ops.join import (
+    BroadcastHashJoinExec, BroadcastNestedLoopJoinExec,
+    ShuffledHashJoinExec)
+from spark_rapids_tpu.parallel import (
+    HashPartitioning, RangePartitioning, RoundRobinPartitioning,
+    ShuffleExchangeExec, SinglePartitioning)
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import Column, LogicalPlan, resolve
+
+
+# ---------------------------------------------------------------------------
+# Expression tagging rules (GpuOverrides expr registry analog)
+# ---------------------------------------------------------------------------
+
+# Kinds whose device implementation can differ from the JVM in corner cases.
+_INCOMPAT_EXPRS = {
+    "upper": "locale-sensitive case mapping is ASCII-only on TPU",
+    "lower": "locale-sensitive case mapping is ASCII-only on TPU",
+}
+
+# Kinds that execute on the host even inside the device plan (regex etc.).
+_HOST_ROUNDTRIP_EXPRS = {"regexp_replace"}
+
+
+def _expr_conf_key(kind: str) -> str:
+    return f"spark.rapids.sql.expression.{kind}"
+
+
+def _exec_conf_key(name: str) -> str:
+    return f"spark.rapids.sql.exec.{name}"
+
+
+def tag_column(c: Column, conf: C.TpuConf, reasons: List[str],
+               notes: List[str]):
+    """Walk an untyped Column AST, collecting fallback reasons."""
+    kind = c.node[0]
+    if not conf.is_op_enabled(_expr_conf_key(kind)):
+        reasons.append(f"expression {kind} disabled by "
+                       f"{_expr_conf_key(kind)}")
+    if kind in _INCOMPAT_EXPRS and not conf.incompatible_ops:
+        reasons.append(
+            f"expression {kind} is incompatible ({_INCOMPAT_EXPRS[kind]}); "
+            "enable spark.rapids.sql.incompatibleOps.enabled to allow")
+    if kind in _HOST_ROUNDTRIP_EXPRS:
+        notes.append(f"expression {kind} runs via a host roundtrip")
+    for x in c.node[1:]:
+        if isinstance(x, Column):
+            tag_column(x, conf, reasons, notes)
+        elif isinstance(x, tuple):
+            for y in x:
+                if isinstance(y, Column):
+                    tag_column(y, conf, reasons, notes)
+                elif isinstance(y, tuple):
+                    for z in y:
+                        if isinstance(z, Column):
+                            tag_column(z, conf, reasons, notes)
+
+
+def _float_agg_reasons(agg_col: Column, schema, conf: C.TpuConf,
+                       reasons: List[str]):
+    """Order-dependent float aggregation gate (GpuOverrides checks on
+    variableFloatAgg, RapidsConf.scala:149 analog in config.py)."""
+    kind = agg_col.node[1]
+    child = agg_col.node[2]
+    if kind in ("sum", "avg") and child is not None:
+        try:
+            t = resolve(child, schema).data_type()
+        except Exception:
+            return
+        if t.is_floating and not conf.get(C.VARIABLE_FLOAT_AGG):
+            reasons.append(
+                f"{kind} over {t.name} can vary with evaluation order on "
+                "TPU; enable spark.rapids.sql.variableFloatAgg.enabled")
+
+
+# ---------------------------------------------------------------------------
+# Node meta (RapidsMeta analog)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class NodeMeta:
+    plan: LogicalPlan
+    children: List["NodeMeta"]
+    reasons: List[str] = dataclasses.field(default_factory=list)
+    notes: List[str] = dataclasses.field(default_factory=list)
+
+    @property
+    def on_device(self) -> bool:
+        return not self.reasons
+
+    def explain_lines(self, depth: int = 0, not_on_device_only=False):
+        mark = "*" if self.on_device else "!"
+        line = "  " * depth + f"{mark}Exec <{self.plan.name}>"
+        if self.reasons:
+            line += " cannot run on TPU because " + "; ".join(self.reasons)
+        elif self.notes:
+            line += " (" + "; ".join(self.notes) + ")"
+        out = [] if (not_on_device_only and self.on_device and
+                     not self.notes) else [line]
+        for ch in self.children:
+            out.extend(ch.explain_lines(depth + 1, not_on_device_only))
+        return out
+
+
+def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
+    meta = NodeMeta(plan, [wrap_and_tag(c, conf) for c in plan.children])
+    reasons, notes = meta.reasons, meta.notes
+    if not conf.sql_enabled:
+        reasons.append("spark.rapids.sql.enabled is false")
+    if not conf.is_op_enabled(_exec_conf_key(plan.name)):
+        reasons.append(f"disabled by {_exec_conf_key(plan.name)}")
+
+    if isinstance(plan, L.LogicalFilter):
+        tag_column(plan.condition, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalProject):
+        for _, c in plan.projections:
+            tag_column(c, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalAggregate):
+        for _, c in plan.group_by:
+            tag_column(c, conf, reasons, notes)
+        for _, c in plan.aggregates:
+            ac = _unalias(c)
+            inner = ac.node[2] if ac.node[0] == "agg" else None
+            if inner is not None:
+                tag_column(inner, conf, reasons, notes)
+            if ac.node[0] == "agg":
+                _float_agg_reasons(ac, plan.child.schema, conf, reasons)
+    elif isinstance(plan, L.LogicalSort):
+        for o in plan.orders:
+            inner = o.node[1] if o.node[0] == "sortorder" else o
+            tag_column(inner, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalJoin):
+        for k in plan.left_keys + plan.right_keys:
+            tag_column(k, conf, reasons, notes)
+        if plan.condition is not None:
+            tag_column(plan.condition, conf, reasons, notes)
+    return meta
+
+
+# ---------------------------------------------------------------------------
+# Aggregate resolution
+# ---------------------------------------------------------------------------
+
+def _unalias(c: Column) -> Column:
+    while c.node[0] == "alias":
+        c = c.node[1]
+    return c
+
+
+def resolve_agg(c: Column, schema) -> "AggFunctionLike":
+    c = _unalias(c)
+    assert c.node[0] == "agg", f"not an aggregate: {c.node[0]}"
+    kind = c.node[1]
+    child_col = c.node[2]
+    child = None if child_col is None else resolve(child_col, schema)
+    if kind == "count":
+        return CountStar(None) if child is None else Count(child)
+    if kind == "sum":
+        return Sum(child)
+    if kind == "min":
+        return Min(child)
+    if kind == "max":
+        return Max(child)
+    if kind == "avg":
+        return Average(child)
+    if kind == "first":
+        return First(child, c.node[3] if len(c.node) > 3 else True)
+    if kind == "last":
+        return Last(child, c.node[3] if len(c.node) > 3 else True)
+    raise L.ResolutionError(f"unknown aggregate {kind!r}")
+
+
+AggFunctionLike = object
+
+
+# ---------------------------------------------------------------------------
+# Conversion (convertIfNeeded + transition insertion)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PhysicalPlan:
+    """Planner output: root exec + which engine the root runs on + the
+    tagged meta tree for explain/test-mode + the conf the query was
+    planned with (runtime-read configs must see the same values)."""
+
+    root: Exec
+    root_on_device: bool
+    meta: NodeMeta
+    conf: "C.TpuConf" = dataclasses.field(default_factory=C.TpuConf)
+
+    def explain(self, mode: str = "ALL") -> str:
+        lines = self.meta.explain_lines(
+            not_on_device_only=(mode.upper() == "NOT_ON_GPU"))
+        return "\n".join(lines)
+
+    def collect(self, ctx=None):
+        from spark_rapids_tpu.ops.base import ExecContext
+        ctx = ctx or ExecContext(self.conf)
+        return self.root.collect(ctx, device=self.root_on_device)
+
+    def host_fallback_nodes(self) -> List[str]:
+        out = []
+
+        def rec(m: NodeMeta):
+            if not m.on_device:
+                out.append(m.plan.name)
+            for c in m.children:
+                rec(c)
+        rec(self.meta)
+        return out
+
+
+class Planner:
+    """Converts a tagged logical plan into the physical Exec tree."""
+
+    def __init__(self, conf: Optional[C.TpuConf] = None):
+        self.conf = conf or C.TpuConf()
+
+    # -- public --------------------------------------------------------------
+    def plan(self, logical: LogicalPlan) -> PhysicalPlan:
+        meta = wrap_and_tag(logical, self.conf)
+        if self.conf.explain in ("ALL", "NOT_ON_GPU"):
+            print("\n".join(meta.explain_lines(
+                not_on_device_only=self.conf.explain == "NOT_ON_GPU")))
+        root, side = self._convert(meta)
+        phys = PhysicalPlan(root, side, meta, self.conf)
+        if self.conf.test_enabled:
+            allowed = {s for s in str(self.conf.get(
+                C.TEST_ALLOWED_NONTPU)).split(",") if s}
+            bad = [n for n in phys.host_fallback_nodes()
+                   if n not in allowed]
+            if bad:
+                raise AssertionError(
+                    f"Query would execute on host: {bad} "
+                    "(spark.rapids.sql.test.enabled)")
+        return phys
+
+    # -- helpers -------------------------------------------------------------
+    def _bridge(self, child_exec: Exec, child_dev: bool,
+                want_dev: bool) -> Exec:
+        if child_dev == want_dev:
+            return child_exec
+        return HostToDeviceExec(child_exec) if want_dev \
+            else DeviceToHostExec(child_exec)
+
+    def _shuffle_partitions(self) -> int:
+        return self.conf.get(C.SHUFFLE_PARTITIONS)
+
+    def _convert(self, meta: NodeMeta) -> Tuple[Exec, bool]:
+        plan = meta.plan
+        want_dev = meta.on_device
+        kids = [self._convert(c) for c in meta.children]
+
+        if isinstance(plan, L.InMemoryScan):
+            return InMemorySourceExec(plan.schema, plan.partitions), want_dev
+        if isinstance(plan, L.FileScan):
+            from spark_rapids_tpu.io import make_scan_exec
+            return make_scan_exec(plan, self.conf), want_dev
+        if isinstance(plan, L.LogicalRange):
+            return RangeExec(plan.start, plan.end, plan.step,
+                             plan.num_partitions), want_dev
+        if isinstance(plan, L.LogicalFilter):
+            child, cdev = kids[0]
+            cond = resolve(plan.condition, plan.child.schema)
+            return FilterExec(self._bridge(child, cdev, want_dev),
+                              cond), want_dev
+        if isinstance(plan, L.LogicalProject):
+            child, cdev = kids[0]
+            projections = [(n, resolve(c, plan.child.schema))
+                           for n, c in plan.projections]
+            return ProjectExec(self._bridge(child, cdev, want_dev),
+                               projections), want_dev
+        if isinstance(plan, L.LogicalUnion):
+            bridged = [self._bridge(ch, cdev, want_dev)
+                       for ch, cdev in kids]
+            return UnionExec(*bridged), want_dev
+        if isinstance(plan, L.LogicalLimit):
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            local = LocalLimitExec(child, plan.n)
+            single = ShuffleExchangeExec(local, SinglePartitioning())
+            return GlobalLimitExec(single, plan.n), want_dev
+        if isinstance(plan, L.LogicalRepartition):
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            if plan.keys:
+                keys = [resolve(k, plan.child.schema) for k in plan.keys]
+                part = HashPartitioning(keys, plan.num_partitions)
+            else:
+                part = RoundRobinPartitioning(plan.num_partitions)
+            return ShuffleExchangeExec(child, part), want_dev
+        if isinstance(plan, L.LogicalSort):
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            orders = self._sort_orders(plan)
+            # Global order: range-exchange into sorted partition ranges
+            # first (Spark's requiredChildDistribution for global sort).
+            ex = ShuffleExchangeExec(
+                child, RangePartitioning(orders, self._shuffle_partitions()))
+            return SortExec(ex, orders), want_dev
+        if isinstance(plan, L.LogicalAggregate):
+            return self._convert_aggregate(plan, meta, kids[0], want_dev)
+        if isinstance(plan, L.LogicalJoin):
+            return self._convert_join(plan, meta, kids, want_dev)
+        raise NotImplementedError(f"cannot convert {plan.name}")
+
+    def _sort_orders(self, plan: L.LogicalSort) -> List[SortOrder]:
+        orders = []
+        for o in plan.orders:
+            if o.node[0] == "sortorder":
+                inner, asc, nf = o.node[1], o.node[2], o.node[3]
+            else:
+                inner, asc, nf = o, True, True
+            orders.append(SortOrder(resolve(inner, plan.child.schema),
+                                    asc, nf))
+        return orders
+
+    def _convert_aggregate(self, plan: L.LogicalAggregate, meta: NodeMeta,
+                           kid, want_dev: bool) -> Tuple[Exec, bool]:
+        child, cdev = kid
+        child = self._bridge(child, cdev, want_dev)
+        schema = plan.child.schema
+        group_by = [(n, resolve(c, schema)) for n, c in plan.group_by]
+        aggs = [AggSpec(n, resolve_agg(c, schema))
+                for n, c in plan.aggregates]
+        # Two-stage: partial -> exchange on group keys -> final
+        # (aggregate.scala partial/final mode pair across the shuffle).
+        partial = HashAggregateExec(child, group_by, aggs, mode="partial")
+        nkeys = len(group_by)
+        if nkeys:
+            keys = [BoundReference(i, e.data_type())
+                    for i, (_, e) in enumerate(group_by)]
+            part = HashPartitioning(keys, self._shuffle_partitions())
+        else:
+            part = SinglePartitioning()
+        ex = ShuffleExchangeExec(partial, part)
+        final_groups = [
+            (n, BoundReference(i, e.data_type()))
+            for i, (n, e) in enumerate(group_by)]
+        final = HashAggregateExec(ex, final_groups, aggs, mode="final")
+        return final, want_dev
+
+    def _convert_join(self, plan: L.LogicalJoin, meta: NodeMeta, kids,
+                      want_dev: bool) -> Tuple[Exec, bool]:
+        (lch, ldev), (rch, rdev) = kids
+        lch = self._bridge(lch, ldev, want_dev)
+        rch = self._bridge(rch, rdev, want_dev)
+        ls, rs = plan.children[0].schema, plan.children[1].schema
+        lkeys = [resolve(k, ls) for k in plan.left_keys]
+        rkeys = [resolve(k, rs) for k in plan.right_keys]
+        cond = None
+        if plan.condition is not None:
+            cond = resolve(plan.condition, tuple(ls) + tuple(rs))
+        if not lkeys:
+            return BroadcastNestedLoopJoinExec(
+                lch, rch, plan.join_type, cond), want_dev
+        strategy = plan.strategy
+        if strategy == "auto":
+            # Without table stats, broadcast unless full outer (which needs
+            # co-partitioning); AQE-style stats can upgrade this later.
+            strategy = "shuffle" if plan.join_type == "full" \
+                else "broadcast"
+        if strategy == "broadcast":
+            return BroadcastHashJoinExec(
+                lch, rch, lkeys, rkeys, plan.join_type, cond), want_dev
+        n = self._shuffle_partitions()
+        lex = ShuffleExchangeExec(lch, HashPartitioning(lkeys, n))
+        rex = ShuffleExchangeExec(rch, HashPartitioning(rkeys, n))
+        return ShuffledHashJoinExec(
+            lex, rex, lkeys, rkeys, plan.join_type, cond), want_dev

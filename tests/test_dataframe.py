@@ -1,0 +1,268 @@
+"""DataFrame API + plan-rewrite layer tests.
+
+Covers the tag->convert lifecycle: kill-switch fallbacks, incompat gating,
+explain report, test mode, and end-to-end query shapes through the planner
+(the SparkQueryCompareTestSuite style, now at the API level: collect() on
+the device plan vs collect_host() on the host oracle engine).
+"""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.api import (
+    TpuSession, agg_avg, agg_count, agg_max, agg_min, agg_sum, col, upper,
+    when)
+from spark_rapids_tpu.plan.logical import lit_col
+
+from harness import assert_rows_equal
+
+
+@pytest.fixture
+def session():
+    # Float aggs enabled for tests (results compared approx).
+    return TpuSession({
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.incompatibleOps.enabled": True,
+    })
+
+
+SCHEMA = [("k", dt.STRING), ("v", dt.INT32), ("x", dt.FLOAT64)]
+DATA = {
+    "k": ["a", "b", "a", None, "b", "a", "c", None],
+    "v": [1, 2, 3, 4, None, 6, 7, 8],
+    "x": [1.0, 2.5, float("nan"), 4.0, 5.0, None, 7.5, 8.0],
+}
+
+
+def dual_collect(df, approx_float=False, sort_result=True):
+    dev = df.collect()
+    host = df.collect_host()
+    if sort_result:
+        keyf = lambda r: tuple((v is None, str(v)) for v in r)
+        dev, host = sorted(dev, key=keyf), sorted(host, key=keyf)
+    assert_rows_equal(dev, host, approx_float, "device vs host engine")
+    return dev
+
+
+class TestDataFrameBasics:
+    def test_filter_select(self, session):
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = dual_collect(
+            df.filter(col("v") > 3).select("k", (col("v") * 10).alias("v10")))
+        assert sorted(out, key=str) == sorted(
+            [(None, 40), ("a", 60), ("c", 70), (None, 80)], key=str)
+
+    def test_with_column_case_when(self, session):
+        df = session.create_dataframe(DATA, SCHEMA)
+        df = df.with_column(
+            "size", when(col("v") < 3, "small").otherwise("big"))
+        out = dual_collect(df.select("v", "size"))
+        assert ("small" in {r[1] for r in out} and
+                "big" in {r[1] for r in out})
+
+    def test_group_by_agg(self, session):
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = dual_collect(
+            df.group_by("k").agg(
+                agg_count().alias("n"),
+                agg_sum(col("v")).alias("sv"),
+                agg_avg(col("x")).alias("ax")), approx_float=True)
+        asmap = {r[0]: r[1:] for r in out}
+        assert asmap["a"][0] == 3 and asmap["a"][1] == 10
+        assert asmap[None][0] == 2 and asmap[None][1] == 12
+
+    def test_global_agg(self, session):
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=3)
+        out = dual_collect(df.agg(agg_count().alias("n"),
+                                  agg_min(col("v")).alias("mn"),
+                                  agg_max(col("v")).alias("mx")))
+        assert out == [(8, 1, 8)]
+
+    def test_order_by_limit(self, session):
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = df.order_by(col("v").desc()).limit(3).collect()
+        assert [r[1] for r in out] == [8, 7, 6]
+
+    def test_join_api(self, session):
+        orders = session.create_dataframe(
+            {"ok": [1, 2, 3], "cust": [10, 20, 10]},
+            [("ok", dt.INT32), ("cust", dt.INT32)])
+        cust = session.create_dataframe(
+            {"ck": [10, 30], "name": ["alice", "carol"]},
+            [("ck", dt.INT32), ("name", dt.STRING)])
+        out = dual_collect(orders.join_on(cust, ["cust"], ["ck"], "left"))
+        assert sorted(out, key=str) == sorted(
+            [(1, 10, 10, "alice"), (3, 10, 10, "alice"),
+             (2, 20, None, None)], key=str)
+
+    def test_union_repartition(self, session):
+        df = session.create_dataframe(DATA, SCHEMA)
+        u = df.union(df).repartition(3, "k")
+        out = dual_collect(u)
+        assert len(out) == 16
+
+    def test_range(self, session):
+        out = dual_collect(
+            session.range(0, 30, 3, num_partitions=2), sort_result=False)
+        assert sorted(r[0] for r in out) == list(range(0, 30, 3))
+
+    def test_shuffled_join_strategy(self, session):
+        left = session.create_dataframe(
+            {"k": [1, 2, 2, 3], "v": [10, 20, 21, 30]},
+            [("k", dt.INT32), ("v", dt.INT32)], num_partitions=2)
+        right = session.create_dataframe(
+            {"k2": [2, 3, 4], "w": [200, 300, 400]},
+            [("k2", dt.INT32), ("w", dt.INT32)])
+        out = dual_collect(left.join_on(right, ["k"], ["k2"], "full",
+                                        strategy="shuffle"))
+        assert sorted(out, key=str) == sorted(
+            [(1, 10, None, None), (2, 20, 2, 200), (2, 21, 2, 200),
+             (3, 30, 3, 300), (None, None, 4, 400)], key=str)
+
+
+class TestPlanRewrite:
+    def test_exec_kill_switch_falls_back(self):
+        s = TpuSession({"spark.rapids.sql.exec.LogicalFilter": False})
+        df = s.create_dataframe(DATA, SCHEMA).filter(col("v") > 3)
+        phys = df._physical()
+        assert "LogicalFilter" in phys.host_fallback_nodes()
+        # Still correct via the host island:
+        assert len(phys.collect()) == 4
+
+    def test_expression_kill_switch(self):
+        s = TpuSession({"spark.rapids.sql.expression.gt": False})
+        df = s.create_dataframe(DATA, SCHEMA).filter(col("v") > 3)
+        report = df._physical().explain()
+        assert "expression gt disabled" in report
+        assert len(df.collect()) == 4
+
+    def test_incompat_upper_fallback_by_default(self):
+        s = TpuSession()
+        df = s.create_dataframe(DATA, SCHEMA).select(
+            upper(col("k")).alias("K"))
+        phys = df._physical()
+        assert "LogicalProject" in phys.host_fallback_nodes()
+        s2 = TpuSession({"spark.rapids.sql.incompatibleOps.enabled": True})
+        df2 = s2.create_dataframe(DATA, SCHEMA).select(
+            upper(col("k")).alias("K"))
+        assert df2._physical().host_fallback_nodes() == []
+        assert sorted(df.collect(), key=str) == \
+            sorted(df2.collect(), key=str)
+
+    def test_float_agg_gate(self):
+        s = TpuSession()
+        df = s.create_dataframe(DATA, SCHEMA).group_by("k").agg(
+            agg_sum(col("x")).alias("sx"))
+        phys = df._physical()
+        assert any("vary with evaluation order" in r
+                   for r in phys.meta.reasons)
+
+    def test_test_mode_fails_on_host_node(self):
+        s = TpuSession({
+            "spark.rapids.sql.exec.LogicalFilter": False,
+            "spark.rapids.sql.test.enabled": True,
+        })
+        df = s.create_dataframe(DATA, SCHEMA).filter(col("v") > 3)
+        with pytest.raises(AssertionError, match="execute on host"):
+            df._physical()
+
+    def test_test_mode_allowlist(self):
+        s = TpuSession({
+            "spark.rapids.sql.exec.LogicalFilter": False,
+            "spark.rapids.sql.test.enabled": True,
+            "spark.rapids.sql.test.allowedNonTpu": "LogicalFilter",
+        })
+        df = s.create_dataframe(DATA, SCHEMA).filter(col("v") > 3)
+        df._physical()   # no raise
+
+    def test_explain_report(self, session):
+        df = session.create_dataframe(DATA, SCHEMA) \
+            .filter(col("v") > 3).group_by("k").agg(
+                agg_count().alias("n"))
+        report = df._physical().explain()
+        assert "*Exec <LogicalAggregate>" in report
+        assert "*Exec <LogicalFilter>" in report
+        assert "*Exec <InMemoryScan>" in report
+
+    def test_sql_enabled_false_runs_all_host(self):
+        s = TpuSession({"spark.rapids.sql.enabled": False})
+        df = s.create_dataframe(DATA, SCHEMA).filter(col("v") > 3)
+        phys = df._physical()
+        assert not phys.root_on_device
+        assert len(phys.collect()) == 4
+
+
+class TestIO:
+    def test_parquet_roundtrip(self, session, tmp_path):
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = str(tmp_path / "t.parquet")
+        df.write.mode("overwrite").parquet(out)
+        back = session.read.parquet(*sorted(
+            str(p) for p in (tmp_path / "t.parquet").glob("part-*")))
+        got = dual_collect(back)
+        exp = sorted(df.collect(), key=lambda r: tuple(
+            (v is None, str(v)) for v in r))
+        assert_rows_equal(got, exp, msg="parquet roundtrip")
+
+    def test_parquet_reader_types(self, session, tmp_path):
+        df = session.create_dataframe(DATA, SCHEMA)
+        out = str(tmp_path / "t2")
+        df.write.mode("overwrite").parquet(out)
+        paths = sorted(str(p) for p in (tmp_path / "t2").glob("part-*"))
+        for rt in ("PERFILE", "MULTITHREADED", "COALESCING"):
+            s = TpuSession({
+                "spark.rapids.sql.format.parquet.reader.type": rt,
+                "spark.rapids.sql.incompatibleOps.enabled": True,
+            })
+            back = s.read.parquet(*paths)
+            assert len(back.collect()) == 8
+
+    def test_csv_roundtrip(self, session, tmp_path):
+        schema = [("a", dt.INT64), ("b", dt.STRING)]
+        df = session.create_dataframe(
+            {"a": [1, 2, 3], "b": ["x", "y", "z"]}, schema)
+        out = str(tmp_path / "t.csv")
+        df.write.mode("overwrite").csv(out)
+        back = session.read.csv(*sorted(
+            str(p) for p in (tmp_path / "t.csv").glob("part-*")))
+        assert sorted(back.collect()) == [(1, "x"), (2, "y"), (3, "z")]
+
+    def test_orc_roundtrip(self, session, tmp_path):
+        schema = [("a", dt.INT64), ("x", dt.FLOAT64)]
+        df = session.create_dataframe(
+            {"a": [1, 2, None], "x": [1.5, None, 3.5]}, schema)
+        out = str(tmp_path / "t.orc")
+        df.write.mode("overwrite").orc(out)
+        back = session.read.orc(*sorted(
+            str(p) for p in (tmp_path / "t.orc").glob("part-*")))
+        got = dual_collect(back)
+        assert got == sorted(
+            [(1, 1.5), (2, None), (None, 3.5)],
+            key=lambda r: tuple((v is None, str(v)) for v in r))
+
+    def test_q1_like_from_parquet(self, session, tmp_path):
+        rng = np.random.default_rng(7)
+        n = 5000
+        df = session.create_dataframe(
+            {"flag": rng.choice(["A", "N", "R"], n).tolist(),
+             "qty": rng.integers(1, 50, n).tolist(),
+             "price": (rng.random(n) * 100).tolist()},
+            [("flag", dt.STRING), ("qty", dt.INT64),
+             ("price", dt.FLOAT64)], num_partitions=2)
+        path = str(tmp_path / "lineitem")
+        df.write.mode("overwrite").parquet(path)
+        files = sorted(str(p) for p in
+                       (tmp_path / "lineitem").glob("part-*"))
+        q = (session.read.parquet(*files)
+             .filter(col("qty") <= 45)
+             .group_by("flag")
+             .agg(agg_sum(col("qty")).alias("sum_qty"),
+                  agg_avg(col("price")).alias("avg_price"),
+                  agg_count().alias("n"))
+             .order_by("flag"))
+        out = dual_collect(q, approx_float=True, sort_result=False)
+        assert [r[0] for r in out] == ["A", "N", "R"]
