@@ -1,0 +1,198 @@
+// Native disk spill store (ref: RapidsDiskStore.scala +
+// AddressSpaceAllocator.scala + RapidsDiskBlockManager.scala — the
+// reference's disk tier writes spilled device buffers into per-executor
+// files; this is the same design as a C component: one large spill file
+// per store, a first-fit address-space allocator handing out file ranges,
+// and pread/pwrite data movement that bypasses Python entirely for the
+// byte shuffling).
+//
+// C ABI (used from Python via ctypes — no pybind11 in this environment):
+//   spill_store_create(dir)            -> handle (opaque ptr)
+//   spill_store_write(h, buf, len)     -> block id (>=0) or -errno
+//   spill_store_read(h, id, buf, len)  -> bytes read or -errno
+//   spill_store_block_size(h, id)      -> size or -1
+//   spill_store_free(h, id)            -> 0/-1 (range returns to allocator)
+//   spill_store_allocated_bytes(h)     -> live bytes
+//   spill_store_file_bytes(h)          -> current spill file size
+//   spill_store_destroy(h)
+//
+// Thread safety: a single mutex per store (matches the reference's
+// synchronized stores; spills are IO-bound, not lock-bound).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+// First-fit allocator over the spill file's address space
+// (AddressSpaceAllocator.scala). Free ranges are kept sorted by offset and
+// coalesced on free.
+class AddressSpaceAllocator {
+ public:
+  uint64_t Allocate(uint64_t size) {
+    for (auto it = free_.begin(); it != free_.end(); ++it) {
+      if (it->second >= size) {
+        uint64_t offset = it->first;
+        uint64_t remaining = it->second - size;
+        free_.erase(it);
+        if (remaining > 0) {
+          free_[offset + size] = remaining;
+        }
+        return offset;
+      }
+    }
+    // Extend the address space.
+    uint64_t offset = end_;
+    end_ += size;
+    return offset;
+  }
+
+  void Free(uint64_t offset, uint64_t size) {
+    auto it = free_.insert({offset, size}).first;
+    // Coalesce with next.
+    auto next = std::next(it);
+    if (next != free_.end() && it->first + it->second == next->first) {
+      it->second += next->second;
+      free_.erase(next);
+    }
+    // Coalesce with prev.
+    if (it != free_.begin()) {
+      auto prev = std::prev(it);
+      if (prev->first + prev->second == it->first) {
+        prev->second += it->second;
+        free_.erase(it);
+      }
+    }
+  }
+
+  uint64_t end() const { return end_; }
+
+ private:
+  std::map<uint64_t, uint64_t> free_;  // offset -> size
+  uint64_t end_ = 0;
+};
+
+struct Block {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct Store {
+  int fd = -1;
+  std::string path;
+  AddressSpaceAllocator alloc;
+  std::map<int64_t, Block> blocks;
+  int64_t next_id = 0;
+  uint64_t live_bytes = 0;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* spill_store_create(const char* dir) {
+  std::string path = std::string(dir) + "/spill-XXXXXX";
+  std::vector<char> tmpl(path.begin(), path.end());
+  tmpl.push_back('\0');
+  int fd = mkstemp(tmpl.data());
+  if (fd < 0) return nullptr;
+  // Unlink immediately: the file lives until the store closes, and the OS
+  // reclaims it even on crash (RapidsDiskBlockManager's temp-file habit).
+  unlink(tmpl.data());
+  Store* s = new Store();
+  s->fd = fd;
+  s->path.assign(tmpl.data());
+  return s;
+}
+
+int64_t spill_store_write(void* h, const uint8_t* buf, uint64_t len) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  uint64_t offset = s->alloc.Allocate(len);
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = pwrite(s->fd, buf + done, len - done,
+                       static_cast<off_t>(offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      s->alloc.Free(offset, len);
+      return -static_cast<int64_t>(errno);
+    }
+    done += static_cast<uint64_t>(n);
+  }
+  int64_t id = s->next_id++;
+  s->blocks[id] = Block{offset, len};
+  s->live_bytes += len;
+  return id;
+}
+
+int64_t spill_store_read(void* h, int64_t id, uint8_t* buf, uint64_t len) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->blocks.find(id);
+  if (it == s->blocks.end()) return -ENOENT;
+  uint64_t to_read = it->second.size < len ? it->second.size : len;
+  uint64_t done = 0;
+  while (done < to_read) {
+    ssize_t n = pread(s->fd, buf + done, to_read - done,
+                      static_cast<off_t>(it->second.offset + done));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -static_cast<int64_t>(errno);
+    }
+    if (n == 0) break;
+    done += static_cast<uint64_t>(n);
+  }
+  return static_cast<int64_t>(done);
+}
+
+int64_t spill_store_block_size(void* h, int64_t id) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->blocks.find(id);
+  if (it == s->blocks.end()) return -1;
+  return static_cast<int64_t>(it->second.size);
+}
+
+int spill_store_free(void* h, int64_t id) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->blocks.find(id);
+  if (it == s->blocks.end()) return -1;
+  s->alloc.Free(it->second.offset, it->second.size);
+  s->live_bytes -= it->second.size;
+  s->blocks.erase(it);
+  return 0;
+}
+
+uint64_t spill_store_allocated_bytes(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->live_bytes;
+}
+
+uint64_t spill_store_file_bytes(void* h) {
+  Store* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->alloc.end();
+}
+
+void spill_store_destroy(void* h) {
+  Store* s = static_cast<Store*>(h);
+  if (s->fd >= 0) close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,6 @@
+"""Memory management: tiered device/host/disk stores, spillable batches,
+task semaphore (SURVEY.md §2.2)."""
+
+from spark_rapids_tpu.memory.stores import (    # noqa: F401
+    PRIORITY_ACTIVE_INPUT, PRIORITY_DEFAULT, PRIORITY_SHUFFLE_OUTPUT,
+    BufferCatalog, SpillableBatch, StorageTier, TpuSemaphore)

@@ -1,0 +1,182 @@
+"""ctypes bindings for the native spill store (native/spill_store.cpp).
+
+Compiles the C++ on first use (g++ is in the image; pybind11 is not, so
+the binding is a plain C ABI over ctypes). Falls back to a pure-python
+file-backed store when no compiler is available, keeping the engine
+functional everywhere.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "spill_store.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libspillstore.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _compile() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def load():
+    """Load (compiling if needed) the native library; None on failure."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _compile():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.spill_store_create.restype = ctypes.c_void_p
+        lib.spill_store_create.argtypes = [ctypes.c_char_p]
+        lib.spill_store_write.restype = ctypes.c_int64
+        lib.spill_store_write.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.spill_store_read.restype = ctypes.c_int64
+        lib.spill_store_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+            ctypes.c_uint64]
+        lib.spill_store_block_size.restype = ctypes.c_int64
+        lib.spill_store_block_size.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.spill_store_free.restype = ctypes.c_int
+        lib.spill_store_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.spill_store_allocated_bytes.restype = ctypes.c_uint64
+        lib.spill_store_allocated_bytes.argtypes = [ctypes.c_void_p]
+        lib.spill_store_file_bytes.restype = ctypes.c_uint64
+        lib.spill_store_file_bytes.argtypes = [ctypes.c_void_p]
+        lib.spill_store_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativeSpillFile:
+    """One spill file with block ids (native path)."""
+
+    def __init__(self, directory: str):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native spill store unavailable")
+        self._lib = lib
+        os.makedirs(directory, exist_ok=True)
+        self._h = lib.spill_store_create(directory.encode())
+        if not self._h:
+            raise OSError(f"cannot create spill file in {directory}")
+
+    def write(self, data: bytes) -> int:
+        bid = self._lib.spill_store_write(self._h, data, len(data))
+        if bid < 0:
+            raise OSError(f"spill write failed: errno {-bid}")
+        return bid
+
+    def read(self, block_id: int) -> bytes:
+        size = self._lib.spill_store_block_size(self._h, block_id)
+        if size < 0:
+            raise KeyError(block_id)
+        buf = ctypes.create_string_buffer(size)
+        n = self._lib.spill_store_read(self._h, block_id, buf, size)
+        if n < 0:
+            raise OSError(f"spill read failed: errno {-n}")
+        return buf.raw[:n]
+
+    def free(self, block_id: int):
+        self._lib.spill_store_free(self._h, block_id)
+
+    @property
+    def allocated_bytes(self) -> int:
+        return self._lib.spill_store_allocated_bytes(self._h)
+
+    @property
+    def file_bytes(self) -> int:
+        return self._lib.spill_store_file_bytes(self._h)
+
+    def close(self):
+        if self._h:
+            self._lib.spill_store_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class PySpillFile:
+    """Pure-python fallback with the same block-id interface."""
+
+    def __init__(self, directory: str):
+        import tempfile
+        os.makedirs(directory, exist_ok=True)
+        self._f = tempfile.TemporaryFile(dir=directory)
+        self._blocks = {}
+        self._next = 0
+        self._end = 0
+        self._live = 0
+        self._lock = threading.Lock()
+
+    def write(self, data: bytes) -> int:
+        with self._lock:
+            off = self._end
+            self._f.seek(off)
+            self._f.write(data)
+            self._end += len(data)
+            bid = self._next
+            self._next += 1
+            self._blocks[bid] = (off, len(data))
+            self._live += len(data)
+            return bid
+
+    def read(self, block_id: int) -> bytes:
+        with self._lock:
+            off, size = self._blocks[block_id]
+            self._f.seek(off)
+            return self._f.read(size)
+
+    def free(self, block_id: int):
+        with self._lock:
+            blk = self._blocks.pop(block_id, None)
+            if blk:
+                self._live -= blk[1]
+
+    @property
+    def allocated_bytes(self) -> int:
+        return self._live
+
+    @property
+    def file_bytes(self) -> int:
+        return self._end
+
+    def close(self):
+        self._f.close()
+
+
+def open_spill_file(directory: str):
+    """Native store when compilable, python fallback otherwise."""
+    try:
+        return NativeSpillFile(directory)
+    except (RuntimeError, OSError):
+        return PySpillFile(directory)

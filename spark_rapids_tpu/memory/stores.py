@@ -1,0 +1,314 @@
+"""Tiered buffer stores: HBM -> host RAM -> disk (SURVEY.md §2.2 — the
+RapidsBufferCatalog / Rapids{Device,Host,Disk}Store chain re-designed for
+XLA's memory model).
+
+RMM calls back on allocation failure (DeviceMemoryEventHandler.scala:42);
+XLA will not, so the device tier is governed by a **watermark budget**: the
+catalog tracks the bytes of every registered device batch against a budget
+(HBM fraction config) and synchronously spills lowest-priority buffers when
+an admission would cross it (the same synchronousSpill(targetSize) loop as
+RapidsBufferStore.scala:39, driven by admission instead of a callback).
+
+Spill priorities follow SpillPriorities.scala: shuffle outputs spill first,
+actively-read input buffers last.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.columnar.host import (
+    HostBatch, device_to_host, host_to_device)
+
+# SpillPriorities.scala analogs: lower spills first.
+PRIORITY_SHUFFLE_OUTPUT = 0
+PRIORITY_DEFAULT = 50
+PRIORITY_ACTIVE_INPUT = 100
+
+
+class StorageTier:
+    DEVICE = "device"
+    HOST = "host"
+    DISK = "disk"
+
+
+def _batch_to_numpy(batch: DeviceBatch) -> Tuple[dict, list]:
+    """Device batch -> (meta, list of numpy buffers) without trimming
+    padding (exact image, so re-upload restores identical capacities)."""
+    bufs = []
+    cols_meta = []
+    for c in batch.columns:
+        entry = {"dtype": c.dtype.name, "string": c.dtype.is_string}
+        bufs.append(np.asarray(c.data))
+        bufs.append(np.asarray(c.validity))
+        if c.lengths is not None:
+            bufs.append(np.asarray(c.lengths))
+            entry["has_lengths"] = True
+        cols_meta.append(entry)
+    meta = {"cols": cols_meta, "num_rows": int(batch.num_rows)}
+    return meta, bufs
+
+
+def _numpy_to_batch(meta: dict, bufs: list) -> DeviceBatch:
+    import jax.numpy as jnp
+    cols = []
+    bi = 0
+    for entry in meta["cols"]:
+        t = dt.type_named(entry["dtype"])
+        data = jnp.asarray(bufs[bi]); bi += 1
+        validity = jnp.asarray(bufs[bi]); bi += 1
+        lengths = None
+        if entry.get("has_lengths"):
+            lengths = jnp.asarray(bufs[bi]); bi += 1
+        cols.append(DeviceColumn(t, data, validity, lengths))
+    return DeviceBatch(tuple(cols),
+                       jnp.asarray(meta["num_rows"], jnp.int32))
+
+
+def _serialize_bufs(bufs: list) -> Tuple[bytes, list]:
+    """Buffers -> one contiguous byte blob + shape/dtype directory."""
+    directory = []
+    parts = []
+    for a in bufs:
+        a = np.ascontiguousarray(a)
+        raw = a.tobytes()
+        directory.append({"dtype": a.dtype.str, "shape": a.shape,
+                          "nbytes": len(raw)})
+        parts.append(raw)
+    return b"".join(parts), directory
+
+
+def _deserialize_bufs(blob: bytes, directory: list) -> list:
+    out = []
+    off = 0
+    for d in directory:
+        n = d["nbytes"]
+        arr = np.frombuffer(blob[off:off + n],
+                            dtype=np.dtype(d["dtype"])).reshape(d["shape"])
+        out.append(arr)
+        off += n
+    return out
+
+
+@dataclasses.dataclass
+class BufferEntry:
+    buffer_id: int
+    tier: str
+    size_bytes: int
+    priority: int
+    # Exactly one of these is set, per tier:
+    device_batch: Optional[DeviceBatch] = None
+    host_meta: Optional[dict] = None
+    host_bufs: Optional[list] = None
+    disk_meta: Optional[dict] = None
+    disk_directory: Optional[list] = None
+    disk_block: Optional[int] = None
+
+
+class BufferCatalog:
+    """id -> buffer across tiers, with the device->host->disk spill chain
+    (RapidsBufferCatalog.scala:128-142's singleton wiring)."""
+
+    def __init__(self, device_budget_bytes: int = 1 << 34,
+                 host_budget_bytes: int = 1 << 30,
+                 spill_dir: str = "/tmp/spark_rapids_tpu_spill"):
+        from spark_rapids_tpu.memory.native import open_spill_file
+        self.device_budget = device_budget_bytes
+        self.host_budget = host_budget_bytes
+        self._entries: Dict[int, BufferEntry] = {}
+        self._next_id = itertools.count()
+        self._device_bytes = 0
+        self._host_bytes = 0
+        self._lock = threading.RLock()
+        self._spill_file = open_spill_file(spill_dir)
+        self.metrics = {"spill_to_host": 0, "spill_to_disk": 0,
+                        "restore_from_host": 0, "restore_from_disk": 0}
+
+    # -- registration --------------------------------------------------------
+    def add_batch(self, batch: DeviceBatch,
+                  priority: int = PRIORITY_DEFAULT) -> int:
+        size = batch.device_size_bytes()
+        with self._lock:
+            self._ensure_device_room(size)
+            bid = next(self._next_id)
+            self._entries[bid] = BufferEntry(
+                bid, StorageTier.DEVICE, size, priority,
+                device_batch=batch)
+            self._device_bytes += size
+            return bid
+
+    def acquire_batch(self, buffer_id: int) -> DeviceBatch:
+        """Materialize back on device (from whatever tier), re-admitting it
+        under the budget (SpillableColumnarBatch.getColumnarBatch)."""
+        with self._lock:
+            e = self._entries[buffer_id]
+            if e.tier == StorageTier.DEVICE:
+                e.priority = PRIORITY_ACTIVE_INPUT
+                return e.device_batch
+            if e.tier == StorageTier.HOST:
+                self.metrics["restore_from_host"] += 1
+                batch = _numpy_to_batch(e.host_meta, e.host_bufs)
+                self._host_bytes -= e.size_bytes
+            else:
+                self.metrics["restore_from_disk"] += 1
+                blob = self._spill_file.read(e.disk_block)
+                bufs = _deserialize_bufs(blob, e.disk_directory)
+                batch = _numpy_to_batch(e.disk_meta, bufs)
+                self._spill_file.free(e.disk_block)
+            self._ensure_device_room(e.size_bytes)
+            e.tier = StorageTier.DEVICE
+            e.device_batch = batch
+            e.host_meta = e.host_bufs = None
+            e.disk_meta = e.disk_directory = e.disk_block = None
+            e.priority = PRIORITY_ACTIVE_INPUT
+            self._device_bytes += e.size_bytes
+            return batch
+
+    def release(self, buffer_id: int,
+                priority: int = PRIORITY_DEFAULT):
+        """Done reading: buffer becomes spillable again."""
+        with self._lock:
+            e = self._entries.get(buffer_id)
+            if e is not None:
+                e.priority = priority
+
+    def remove(self, buffer_id: int):
+        with self._lock:
+            e = self._entries.pop(buffer_id, None)
+            if e is None:
+                return
+            if e.tier == StorageTier.DEVICE:
+                self._device_bytes -= e.size_bytes
+            elif e.tier == StorageTier.HOST:
+                self._host_bytes -= e.size_bytes
+            elif e.disk_block is not None:
+                self._spill_file.free(e.disk_block)
+
+    # -- spilling ------------------------------------------------------------
+    def _ensure_device_room(self, incoming: int):
+        """synchronousSpill loop: evict lowest-priority device buffers until
+        the incoming batch fits the budget."""
+        while self._device_bytes + incoming > self.device_budget:
+            victim = self._pick_victim(StorageTier.DEVICE)
+            if victim is None:
+                break   # nothing spillable; admit anyway (XLA may OOM)
+            self._spill_device_to_host(victim)
+
+    def _pick_victim(self, tier: str) -> Optional[BufferEntry]:
+        best = None
+        for e in self._entries.values():
+            if e.tier != tier or e.priority >= PRIORITY_ACTIVE_INPUT:
+                continue
+            if best is None or e.priority < best.priority or \
+                    (e.priority == best.priority and
+                     e.buffer_id < best.buffer_id):
+                best = e
+        return best
+
+    def _spill_device_to_host(self, e: BufferEntry):
+        meta, bufs = _batch_to_numpy(e.device_batch)
+        e.device_batch = None
+        e.tier = StorageTier.HOST
+        e.host_meta, e.host_bufs = meta, bufs
+        self._device_bytes -= e.size_bytes
+        self._host_bytes += e.size_bytes
+        self.metrics["spill_to_host"] += 1
+        # Cascade: host over budget -> push host victims to disk.
+        while self._host_bytes > self.host_budget:
+            victim = self._pick_victim(StorageTier.HOST)
+            if victim is None:
+                break
+            self._spill_host_to_disk(victim)
+
+    def _spill_host_to_disk(self, e: BufferEntry):
+        blob, directory = _serialize_bufs(e.host_bufs)
+        block = self._spill_file.write(blob)
+        e.disk_meta = e.host_meta
+        e.disk_directory = directory
+        e.disk_block = block
+        e.host_meta = e.host_bufs = None
+        e.tier = StorageTier.DISK
+        self._host_bytes -= e.size_bytes
+        self.metrics["spill_to_disk"] += 1
+
+    # -- introspection -------------------------------------------------------
+    def tier_of(self, buffer_id: int) -> str:
+        with self._lock:
+            return self._entries[buffer_id].tier
+
+    @property
+    def device_bytes(self) -> int:
+        return self._device_bytes
+
+    @property
+    def host_bytes(self) -> int:
+        return self._host_bytes
+
+    @property
+    def disk_bytes(self) -> int:
+        return self._spill_file.allocated_bytes
+
+    def close(self):
+        self._spill_file.close()
+
+
+class SpillableBatch:
+    """Operator-facing handle that re-materializes from whatever tier the
+    batch is on (SpillableColumnarBatch.scala:27)."""
+
+    def __init__(self, catalog: BufferCatalog, batch: DeviceBatch,
+                 priority: int = PRIORITY_DEFAULT):
+        self._catalog = catalog
+        self._id = catalog.add_batch(batch, priority)
+        self._closed = False
+
+    def get(self) -> DeviceBatch:
+        return self._catalog.acquire_batch(self._id)
+
+    def release(self, priority: int = PRIORITY_DEFAULT):
+        self._catalog.release(self._id, priority)
+
+    def close(self):
+        if not self._closed:
+            self._catalog.remove(self._id)
+            self._closed = True
+
+    def __enter__(self):
+        return self.get()
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TpuSemaphore:
+    """Task-admission semaphore (GpuSemaphore.scala:101):
+    ``spark.rapids.sql.concurrentTpuTasks`` tasks may issue device work at
+    once; auto-release via context manager replaces the task-completion
+    listener."""
+
+    def __init__(self, permits: int = 2):
+        self._sem = threading.Semaphore(permits)
+        self.permits = permits
+
+    def __enter__(self):
+        self._sem.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._sem.release()
+        return False
+
+    def acquire(self):
+        self._sem.acquire()
+
+    def release(self):
+        self._sem.release()

@@ -1,0 +1,148 @@
+"""Spill-tier tests (ref: RapidsDeviceMemoryStoreSuite,
+RapidsHostMemoryStoreSuite, RapidsDiskStoreSuite, RapidsBufferCatalogSuite,
+GpuSemaphoreSuite)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch, host_to_device, \
+    device_to_host
+from spark_rapids_tpu.memory import (
+    PRIORITY_ACTIVE_INPUT, PRIORITY_DEFAULT, PRIORITY_SHUFFLE_OUTPUT,
+    BufferCatalog, SpillableBatch, StorageTier, TpuSemaphore)
+from spark_rapids_tpu.memory.native import (
+    NativeSpillFile, PySpillFile, load, open_spill_file)
+
+
+def make_batch(seed, n=64):
+    rng = np.random.default_rng(seed)
+    hb = HostBatch.from_pydict(
+        [("a", dt.INT64), ("s", dt.STRING)],
+        {"a": rng.integers(0, 1000, n).tolist(),
+         "s": [f"row{seed}_{i}" for i in range(n)]})
+    return host_to_device(hb)
+
+
+class TestNativeSpillFile:
+    def test_native_lib_compiles(self):
+        assert load() is not None, "g++ native spill store must build"
+
+    def test_write_read_free(self, tmp_path):
+        f = open_spill_file(str(tmp_path))
+        assert isinstance(f, NativeSpillFile)
+        b1 = f.write(b"hello world")
+        b2 = f.write(b"x" * 4096)
+        assert f.read(b1) == b"hello world"
+        assert f.read(b2) == b"x" * 4096
+        assert f.allocated_bytes == 11 + 4096
+        f.free(b1)
+        assert f.allocated_bytes == 4096
+        # Freed range is reused (first-fit): write something smaller.
+        b3 = f.write(b"abc")
+        assert f.read(b3) == b"abc"
+        assert f.file_bytes == 11 + 4096   # no growth
+        f.close()
+
+    def test_python_fallback_equivalent(self, tmp_path):
+        f = PySpillFile(str(tmp_path))
+        b1 = f.write(b"data1")
+        assert f.read(b1) == b"data1"
+        f.free(b1)
+        f.close()
+
+
+class TestCatalogSpill:
+    def test_device_to_host_spill_on_budget(self, tmp_path):
+        b = make_batch(1)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 2.5),
+                            host_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(make_batch(i)) for i in range(3)]
+        # Third add must have pushed the first (lowest id) to host.
+        assert cat.tier_of(ids[0]) == StorageTier.HOST
+        assert cat.tier_of(ids[2]) == StorageTier.DEVICE
+        assert cat.metrics["spill_to_host"] >= 1
+        # Re-acquire: comes back to device, bit-identical.
+        restored = cat.acquire_batch(ids[0])
+        assert cat.tier_of(ids[0]) == StorageTier.DEVICE
+        orig = device_to_host(make_batch(1)).to_pylist()
+        assert device_to_host(restored).to_pylist() == orig
+        cat.close()
+
+    def test_cascade_to_disk_and_restore(self, tmp_path):
+        b = make_batch(0)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 1.5),
+                            host_budget_bytes=int(size * 1.5),
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(make_batch(i)) for i in range(4)]
+        tiers = [cat.tier_of(i) for i in ids]
+        assert StorageTier.DISK in tiers
+        assert cat.metrics["spill_to_disk"] >= 1
+        disk_id = ids[tiers.index(StorageTier.DISK)]
+        seed = ids.index(disk_id)
+        restored = cat.acquire_batch(disk_id)
+        expect = device_to_host(make_batch(seed)).to_pylist()
+        assert device_to_host(restored).to_pylist() == expect
+        assert cat.metrics["restore_from_disk"] == 1
+        cat.close()
+
+    def test_priorities_shuffle_spills_first(self, tmp_path):
+        b = make_batch(0)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 2.5),
+                            spill_dir=str(tmp_path))
+        keep = cat.add_batch(make_batch(1), PRIORITY_DEFAULT)
+        shuffle = cat.add_batch(make_batch(2), PRIORITY_SHUFFLE_OUTPUT)
+        cat.add_batch(make_batch(3))   # forces one spill
+        assert cat.tier_of(shuffle) == StorageTier.HOST
+        assert cat.tier_of(keep) == StorageTier.DEVICE
+        cat.close()
+
+    def test_active_input_never_spills(self, tmp_path):
+        b = make_batch(0)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 1.5),
+                            spill_dir=str(tmp_path))
+        active = cat.add_batch(make_batch(1), PRIORITY_ACTIVE_INPUT)
+        cat.add_batch(make_batch(2))
+        cat.add_batch(make_batch(3))
+        assert cat.tier_of(active) == StorageTier.DEVICE
+        cat.close()
+
+    def test_spillable_batch_handle(self, tmp_path):
+        cat = BufferCatalog(spill_dir=str(tmp_path))
+        sb = SpillableBatch(cat, make_batch(5))
+        with sb as batch:
+            assert int(batch.num_rows) == 64
+        sb.close()
+        cat.close()
+
+
+class TestSemaphore:
+    def test_limits_concurrency(self):
+        sem = TpuSemaphore(2)
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        def task():
+            with sem:
+                with lock:
+                    active.append(1)
+                    peak.append(len(active))
+                time.sleep(0.02)
+                with lock:
+                    active.pop()
+
+        threads = [threading.Thread(target=task) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) <= 2
