@@ -20,6 +20,8 @@ JAX/XLA/Pallas rather than ported from the CUDA/cuDF design:
   protocol (ref: shuffle-plugin/ucx/UCX.scala), with a host/disk spill tier.
 """
 
+import os as _os
+
 import jax as _jax
 
 # Spark SQL semantics are 64-bit (LongType, DoubleType, TimestampType are all
@@ -27,6 +29,17 @@ import jax as _jax
 # requires x64 mode. On TPU, int64/float64 lower to emulated ops — the
 # planner keeps hot paths in 32-bit/bfloat16 where Spark semantics allow.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: remote-TPU compiles can take minutes per
+# program; the disk cache makes every shape/kernel a one-time cost across
+# processes (the engine's capacity-bucket ladder keeps the program count
+# bounded, so the cache converges quickly).
+if not _os.environ.get("SRT_NO_COMPILE_CACHE"):
+    _jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/srt_jax_cache"))
+    _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 from spark_rapids_tpu.version import __version__
 

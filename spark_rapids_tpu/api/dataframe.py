@@ -225,7 +225,16 @@ class DataFrame:
 
     # -- actions --------------------------------------------------------------
     def _physical(self):
-        return Planner(self._session.conf).plan(self._plan)
+        # Plan once per (DataFrame, conf version): repeated collects reuse
+        # the same Exec tree so per-exec jitted kernels stay compiled
+        # (re-planning every action would re-trace everything).
+        key = self._session.conf.version
+        cached = getattr(self, "_phys_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        phys = Planner(self._session.conf).plan(self._plan)
+        self._phys_cache = (key, phys)
+        return phys
 
     def collect(self) -> List[tuple]:
         return self._physical().collect()

@@ -1,0 +1,1 @@
+from spark_rapids_tpu.benchmarks import tpch  # noqa: F401

@@ -259,6 +259,37 @@ def concat_batches(batches: Sequence[DeviceBatch], capacity: int) -> DeviceBatch
     return DeviceBatch(tuple(out_cols), total_rows)
 
 
+_JIT_CACHE: dict = {}
+
+
+def jit_concat_batches(batches: Sequence[DeviceBatch],
+                       capacity: int) -> DeviceBatch:
+    """``concat_batches`` under jit. Cached per target capacity; jax's own
+    cache handles distinct input pytree structures. Eager concat is a
+    per-column scatter storm — under jit XLA fuses it into a few copies."""
+    fn = _JIT_CACHE.get(("concat", capacity))
+    if fn is None:
+        fn = jax.jit(lambda bs: concat_batches(bs, capacity))
+        _JIT_CACHE[("concat", capacity)] = fn
+    return fn(list(batches))
+
+
+def shrink_to_capacity(batch: DeviceBatch, capacity: int) -> DeviceBatch:
+    """Re-bucket a batch whose live rows fit a smaller capacity (after a
+    groupby/filter the packed prefix is all that matters). Jitted slice;
+    requires ``num_rows <= capacity <= batch.capacity``."""
+    if capacity >= batch.capacity:
+        return batch
+    fn = _JIT_CACHE.get(("shrink", capacity))
+    if fn is None:
+        def _shrink(b: DeviceBatch) -> DeviceBatch:
+            idx = jnp.arange(capacity, dtype=jnp.int32)
+            return b.gather(idx, b.num_rows)
+        fn = jax.jit(_shrink)
+        _JIT_CACHE[("shrink", capacity)] = fn
+    return fn(batch)
+
+
 def string_repad(col: DeviceColumn, width: int) -> DeviceColumn:
     """Re-pad a string column's byte matrix to ``width`` (static)."""
     assert col.dtype.is_string

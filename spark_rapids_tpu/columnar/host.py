@@ -23,18 +23,43 @@ from spark_rapids_tpu.columnar.batch import (
     DeviceBatch, DeviceColumn, bucket_capacity)
 
 
-@dataclasses.dataclass
 class HostColumn:
     """One host column: values + validity. Strings are ``object`` arrays of
-    python ``bytes`` (None entries are allowed and mean null)."""
+    python ``bytes`` (None entries are allowed and mean null).
 
-    dtype: DataType
-    data: np.ndarray               # (n,) typed, or (n,) object of bytes
-    validity: np.ndarray           # (n,) bool
+    String columns may instead carry the dense device layout directly
+    (``str_matrix`` (n, w) uint8 + ``str_lengths`` int32) — the vectorized
+    fast path used by the arrow bridge and the host<->device transitions so
+    scans never loop per row; the object array is materialized lazily only
+    when a host-oracle kernel asks for ``.data``."""
+
+    def __init__(self, dtype: DataType, data: Optional[np.ndarray],
+                 validity: np.ndarray,
+                 str_matrix: Optional[np.ndarray] = None,
+                 str_lengths: Optional[np.ndarray] = None):
+        self.dtype = dtype
+        self._data = data
+        self.validity = validity
+        self.str_matrix = str_matrix
+        self.str_lengths = str_lengths
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            m, lens, val = self.str_matrix, self.str_lengths, self.validity
+            out = np.empty(m.shape[0], dtype=object)
+            for i in range(m.shape[0]):
+                out[i] = m[i, :lens[i]].tobytes() if val[i] else b""
+            self._data = out
+        return self._data
+
+    @data.setter
+    def data(self, v):
+        self._data = v
 
     @property
     def num_rows(self) -> int:
-        return len(self.data)
+        return len(self.validity)
 
     @classmethod
     def from_values(cls, dtype: DataType, values: Sequence) -> "HostColumn":
@@ -109,7 +134,9 @@ def strings_to_matrix(col: "HostColumn") -> Tuple[np.ndarray, np.ndarray]:
     host->device transition. ``None`` entries (permitted null encoding per
     HostColumn's contract) become empty strings.
     """
-    n = len(col.data)
+    if col.str_matrix is not None:
+        return col.str_matrix, col.str_lengths
+    n = col.num_rows
     vals = [b"" if b is None else bytes(b) for b in col.data]
     w = max([len(b) for b in vals] + [1])
     m = np.zeros((n, w), dtype=np.uint8)
@@ -122,13 +149,13 @@ def strings_to_matrix(col: "HostColumn") -> Tuple[np.ndarray, np.ndarray]:
 
 def matrix_to_strings(data: np.ndarray, lengths: np.ndarray,
                       validity: np.ndarray) -> "HostColumn":
-    """Inverse of strings_to_matrix (nulls become empty bytes)."""
+    """Inverse of strings_to_matrix (nulls become empty bytes). The object
+    array stays lazy: the matrix IS the column until a host kernel asks."""
     from spark_rapids_tpu.columnar import dtypes as _dt
-    n = data.shape[0]
-    out = np.empty(n, dtype=object)
-    for i in range(n):
-        out[i] = data[i, :lengths[i]].tobytes() if validity[i] else b""
-    return HostColumn(_dt.STRING, out, np.asarray(validity, np.bool_))
+    validity = np.asarray(validity, np.bool_)
+    return HostColumn(_dt.STRING, None, validity,
+                      str_matrix=np.asarray(data),
+                      str_lengths=np.asarray(lengths, np.int32))
 
 
 @dataclasses.dataclass
@@ -147,6 +174,33 @@ class StringMatrixView:
     def of(cls, col: "HostColumn") -> "StringMatrixView":
         m, lens = strings_to_matrix(col)
         return cls(col.dtype, m, lens, col.validity)
+
+
+def concat_host_batches(hbs: Sequence["HostBatch"]) -> "HostBatch":
+    """Row-concatenate host batches (vectorized; string columns merge at
+    the byte-matrix level so no object arrays materialize)."""
+    assert hbs, "concat of zero host batches"
+    if len(hbs) == 1:
+        return hbs[0]
+    cols = []
+    for ci, c0 in enumerate(hbs[0].columns):
+        members = [hb.columns[ci] for hb in hbs]
+        val = np.concatenate([m.validity for m in members])
+        if c0.dtype.is_string:
+            mats = [strings_to_matrix(m) for m in members]
+            w = max(mm.shape[1] for mm, _ in mats)
+            mat = np.zeros((len(val), w), np.uint8)
+            lens = np.concatenate([l for _, l in mats]).astype(np.int32)
+            off = 0
+            for mm, _ in mats:
+                mat[off:off + mm.shape[0], :mm.shape[1]] = mm
+                off += mm.shape[0]
+            cols.append(HostColumn(c0.dtype, None, val,
+                                   str_matrix=mat, str_lengths=lens))
+        else:
+            cols.append(HostColumn(
+                c0.dtype, np.concatenate([m.data for m in members]), val))
+    return HostBatch(hbs[0].names, cols)
 
 
 # ---------------------------------------------------------------------------

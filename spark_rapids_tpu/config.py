@@ -237,12 +237,23 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
 METRICS_ENABLED = conf("spark.rapids.sql.metrics.enabled").doc(
     "Collect per-operator metrics (rows/batches/time).").boolean(True)
 
+DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
+    "Explicit HBM budget for the buffer catalog in bytes; 0 derives it "
+    "from allocFraction of the visible device memory (ref: RMM pool "
+    "sizing, GpuDeviceManager.scala:159-230).").long(0)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
 
     def __init__(self, raw: Optional[Dict[str, Any]] = None):
         self.raw = dict(raw or {})
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        """Bumped on every set(); planners cache against it."""
+        return self._version
 
     def get(self, entry: ConfEntry) -> Any:
         return entry.get(self)
@@ -255,6 +266,7 @@ class TpuConf:
 
     def set(self, key: str, value: Any) -> "TpuConf":
         self.raw[key] = value
+        self._version += 1
         return self
 
     def is_op_enabled(self, conf_key: str) -> bool:

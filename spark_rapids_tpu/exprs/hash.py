@@ -94,10 +94,49 @@ def _float_bits(xp, data):
 
 
 def _double_bits(xp, data):
-    bits = data.astype(np.float64).view(np.int64) if xp is np else \
-        jnp.asarray(data, np.float64).view(jnp.int64)
-    nan = xp.isnan(data)
-    return xp.where(nan, np.int64(0x7FF8000000000000), bits)
+    if xp is np:
+        bits = data.astype(np.float64).view(np.int64)
+        nan = np.isnan(data)
+        return np.where(nan, np.int64(0x7FF8000000000000), bits)
+    return _double_bits_device(data)
+
+
+def _double_bits_device(x):
+    """Java doubleToLongBits WITHOUT a 64-bit bitcast (TPU's x64 emulation
+    cannot bitcast f64): decompose sign/exponent/mantissa arithmetically.
+
+    Exponent by binary-search normalization (all multiplies are exact
+    powers of two), mantissa as (m-1)*2^52 which is an exact 52-bit
+    integer. ~40 emulated f64 ops per element; the CPU test suite
+    validates it bit-for-bit against numpy's view() oracle.
+
+    Known deviation: XLA flushes f64 subnormals to zero (FTZ), so
+    subnormal inputs hash as +/-0.0 — the same class of documented float
+    incompatibility the reference gates (GpuOverrides incompat flags)."""
+    x = jnp.asarray(x, jnp.float64)
+    nan = jnp.isnan(x)
+    inf = jnp.isinf(x)
+    zero = (x == 0.0) | (jnp.abs(x) < 2.0 ** -1022)   # FTZ: subnormal -> 0
+    neg = (x < 0) | (1.0 / x < 0)                     # sign incl. -0.0
+    ax = jnp.abs(x)
+    m = ax
+    e = jnp.zeros(x.shape, jnp.int32)
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        c = m >= 2.0 ** k
+        m = jnp.where(c, m * (2.0 ** -k), m)
+        e = e + jnp.where(c, jnp.int32(k), jnp.int32(0))
+    for k in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        c = m < 2.0 ** (1 - k)
+        m = jnp.where(c, m * (2.0 ** k), m)
+        e = e - jnp.where(c, jnp.int32(k), jnp.int32(0))
+    frac = ((m - 1.0) * (2.0 ** 52)).astype(jnp.uint64)
+    bexp = (e + 1023).astype(jnp.uint64)
+    bits = (bexp << jnp.uint64(52)) | frac
+    bits = jnp.where(zero, jnp.uint64(0), bits)
+    bits = jnp.where(inf, jnp.uint64(0x7FF0000000000000), bits)
+    bits = jnp.where(neg, bits | (jnp.uint64(1) << jnp.uint64(63)), bits)
+    bits = jnp.where(nan, jnp.uint64(0x7FF8000000000000), bits)
+    return bits.astype(jnp.int64)
 
 
 def hash_string_matrix(xp, data, lengths, seed_u32):

@@ -69,17 +69,26 @@ def _csv_read_options(options: Dict, sample: bool = False):
 
 
 def _read_file_batches(fmt: str, path: str, options: Dict,
-                       batch_rows: int) -> Iterator[HostBatch]:
+                       batch_rows: int,
+                       columns: Optional[List[str]] = None
+                       ) -> Iterator[HostBatch]:
+    """Decode one file; ``columns`` restricts the read to a pruned schema
+    (GpuParquetScan readDataSchema analog — unread columns are never
+    decoded)."""
     if fmt == "parquet":
         pf = papq.ParquetFile(path)
-        for rb in pf.iter_batches(batch_size=batch_rows):
+        for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
             yield arrow_to_host_batch(rb)
     elif fmt == "orc":
         f = paorc.ORCFile(path)
         for si in range(f.nstripes):
-            yield arrow_to_host_batch(f.read_stripe(si))
+            yield arrow_to_host_batch(f.read_stripe(si, columns=columns))
     elif fmt == "csv":
-        tbl = pacsv.read_csv(path, **_csv_read_options(options))
+        kwargs = _csv_read_options(options)
+        if columns:
+            kwargs["convert_options"] = pacsv.ConvertOptions(
+                include_columns=list(columns))
+        tbl = pacsv.read_csv(path, **kwargs)
         for rb in tbl.to_batches(max_chunksize=batch_rows):
             yield arrow_to_host_batch(rb)
     else:
@@ -97,6 +106,7 @@ class FileScanExec(LeafExec):
         self.paths = list(paths)
         self._schema = tuple(schema)
         self.options = dict(options or {})
+        self._columns = [n for n, _ in self._schema]
         self._parts = num_partitions or min(len(self.paths), 8) or 1
 
     @property
@@ -128,7 +138,7 @@ class FileScanExec(LeafExec):
         rows = self._batch_rows(ctx)
         for path in self._files_of(partition):
             yield from _read_file_batches(self.fmt, path, self.options,
-                                          rows)
+                                          rows, self._columns)
 
     # -- device engine -------------------------------------------------------
     def execute_device(self, ctx, partition):
@@ -144,7 +154,7 @@ class FileScanExec(LeafExec):
             return
         for path in files:   # PERFILE
             for hb in _read_file_batches(self.fmt, path, self.options,
-                                         rows):
+                                         rows, self._columns):
                 with timed(m, "bufferTime"):
                     batch = host_to_device(hb)
                 m.add("numOutputBatches", 1)
@@ -159,7 +169,7 @@ class FileScanExec(LeafExec):
                 max_workers=min(nthreads, max(len(files), 1))) as pool:
             futures = [
                 pool.submit(lambda p=p: list(_read_file_batches(
-                    self.fmt, p, self.options, rows)))
+                    self.fmt, p, self.options, rows, self._columns)))
                 for p in files]
             for fut in futures:
                 for hb in fut.result():
@@ -174,7 +184,7 @@ class FileScanExec(LeafExec):
         pending_rows = 0
         for path in files:
             for hb in _read_file_batches(self.fmt, path, self.options,
-                                         rows):
+                                         rows, self._columns):
                 pending.append(hb)
                 pending_rows += hb.num_rows
                 if pending_rows >= rows:
@@ -184,17 +194,8 @@ class FileScanExec(LeafExec):
             yield self._upload_merged(m, pending)
 
     def _upload_merged(self, m, hbs: List[HostBatch]):
-        from spark_rapids_tpu.columnar.host import HostColumn
-        if len(hbs) == 1:
-            merged = hbs[0]
-        else:
-            cols = []
-            for ci, c0 in enumerate(hbs[0].columns):
-                data = np.concatenate([hb.columns[ci].data for hb in hbs])
-                val = np.concatenate([hb.columns[ci].validity
-                                      for hb in hbs])
-                cols.append(HostColumn(c0.dtype, data, val))
-            merged = HostBatch(hbs[0].names, cols)
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        merged = concat_host_batches(hbs)
         with timed(m, "bufferTime"):
             batch = host_to_device(merged)
         m.add("numOutputBatches", 1)

@@ -153,22 +153,27 @@ class BufferCatalog:
             if e.tier == StorageTier.DEVICE:
                 e.priority = PRIORITY_ACTIVE_INPUT
                 return e.device_batch
+            # Mark in-flight and detach the source tier's state BEFORE
+            # _ensure_device_room: the cascaded device->host spill it can
+            # trigger must never pick this entry as a host->disk victim
+            # (double-decrement of _host_bytes + leaked disk block).
+            e.priority = PRIORITY_ACTIVE_INPUT
             if e.tier == StorageTier.HOST:
                 self.metrics["restore_from_host"] += 1
-                batch = _numpy_to_batch(e.host_meta, e.host_bufs)
+                meta, bufs = e.host_meta, e.host_bufs
+                e.host_meta = e.host_bufs = None
                 self._host_bytes -= e.size_bytes
+                batch = _numpy_to_batch(meta, bufs)
             else:
                 self.metrics["restore_from_disk"] += 1
                 blob = self._spill_file.read(e.disk_block)
                 bufs = _deserialize_bufs(blob, e.disk_directory)
                 batch = _numpy_to_batch(e.disk_meta, bufs)
                 self._spill_file.free(e.disk_block)
+                e.disk_meta = e.disk_directory = e.disk_block = None
             self._ensure_device_room(e.size_bytes)
             e.tier = StorageTier.DEVICE
             e.device_batch = batch
-            e.host_meta = e.host_bufs = None
-            e.disk_meta = e.disk_directory = e.disk_block = None
-            e.priority = PRIORITY_ACTIVE_INPUT
             self._device_bytes += e.size_bytes
             return batch
 

@@ -407,15 +407,13 @@ class HashAggregateExec(Exec):
     def __init__(self, child: Exec,
                  group_by: Sequence[Tuple[str, Expression]],
                  aggregates: Sequence[AggSpec],
-                 mode: str = "complete",
-                 merge_threshold_rows: int = 1 << 20):
+                 mode: str = "complete"):
         super().__init__(child)
         assert mode in ("partial", "final", "complete")
         self.group_names = tuple(n for n, _ in group_by)
         self.group_exprs = [e for _, e in group_by]
         self.aggs = list(aggregates)
         self.mode = mode
-        self.merge_threshold_rows = merge_threshold_rows
 
     # -- schemas -------------------------------------------------------------
     @property
@@ -538,12 +536,21 @@ class HashAggregateExec(Exec):
             ci += nbuf
         return DeviceBatch(tuple(out_cols), batch.num_rows)
 
+    def _jits(self):
+        """One jit wrapper per exec instance — jax caches compiled programs
+        on the wrapper, so partitions and repeated collects reuse them."""
+        if not hasattr(self, "_jit_fns"):
+            self._jit_fns = (jax.jit(self._update_batch),
+                             jax.jit(self._merge_batch),
+                             jax.jit(self._finalize_batch))
+        return self._jit_fns
+
     def execute_device(self, ctx, partition):
         m = ctx.metrics_for(self)
-        update = jax.jit(self._update_batch)
-        merge = jax.jit(self._merge_batch)
-        finalize = jax.jit(self._finalize_batch)
+        update, merge, finalize = self._jits()
 
+        from spark_rapids_tpu.columnar.batch import (
+            jit_concat_batches, shrink_to_capacity)
         acc: Optional[DeviceBatch] = None
         saw_input = False
         offset = 0
@@ -554,19 +561,25 @@ class HashAggregateExec(Exec):
                 partial = merge(batch) if self.mode == "final" \
                     else update(batch, jnp.asarray(offset, jnp.int64))
                 offset += batch.capacity
+                # Shrink each merged partial to its group-count bucket
+                # (one output-size sync per batch — the same sync cuDF's
+                # groupby does) so the running accumulator concat+re-merge
+                # runs at GROUP scale, not input scale. Without this the
+                # accumulator's capacity grows by every input batch.
+                k = max(int(partial.num_rows), 1)
+                partial = shrink_to_capacity(partial, bucket_capacity(k))
                 if acc is None:
                     acc = partial
                 else:
                     cap = bucket_capacity(acc.capacity + partial.capacity)
-                    acc = concat_batches([acc, partial], cap)
-                    if acc.capacity >= self.merge_threshold_rows:
-                        acc = merge(acc)
+                    acc = merge(jit_concat_batches([acc, partial], cap))
+                    k = max(int(acc.num_rows), 1)
+                    acc = shrink_to_capacity(acc, bucket_capacity(k))
         if not saw_input or acc is None:
             if self._nkeys == 0 and self.mode in ("final", "complete"):
                 yield self._empty_result()
             return
         with timed(m):
-            acc = merge(acc)
             if self.mode in ("final", "complete"):
                 acc = finalize(acc)
         m.add("numOutputBatches", 1)

@@ -53,12 +53,53 @@ class ExecContext:
     conf: TpuConf = dataclasses.field(default_factory=TpuConf)
     metrics: Dict[str, Metrics] = dataclasses.field(default_factory=dict)
     cache: Dict[str, object] = dataclasses.field(default_factory=dict)
+    _catalog: Optional[object] = None
 
     def metrics_for(self, op: "Exec") -> Metrics:
         key = f"{type(op).__name__}@{id(op):x}"
         if key not in self.metrics:
             self.metrics[key] = Metrics()
         return self.metrics[key]
+
+    @property
+    def catalog(self):
+        """Lazily-built spill catalog: every held batch (shuffle buckets,
+        broadcast tables, buffered build sides) registers here so HBM
+        pressure spills device->host->disk instead of OOMing
+        (RapidsBufferCatalog.init wiring, RapidsBufferCatalog.scala:128)."""
+        if self._catalog is None:
+            from spark_rapids_tpu import config as C
+            from spark_rapids_tpu.memory.stores import BufferCatalog
+            budget = int(self.conf.get(C.DEVICE_BUDGET_BYTES))
+            if budget <= 0:
+                budget = int(_visible_device_bytes()
+                             * float(self.conf.get(C.HBM_POOL_FRACTION)))
+            self._catalog = BufferCatalog(
+                device_budget_bytes=budget,
+                host_budget_bytes=int(
+                    self.conf.get(C.HOST_SPILL_STORAGE_SIZE)),
+                spill_dir=str(self.conf.get(C.SPILL_DIR)))
+        return self._catalog
+
+    def close(self):
+        if self._catalog is not None:
+            self._catalog.close()
+            self._catalog = None
+
+
+def _visible_device_bytes() -> int:
+    """Best-effort HBM size of device 0 (fallback 8 GiB)."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit") or stats.get(
+                "bytes_reservable_limit")
+            if limit:
+                return int(limit)
+    except Exception:
+        pass
+    return 8 << 30
 
 
 class Exec:

@@ -58,6 +58,8 @@ class BuiltSide:
     matchable: jnp.ndarray      # (cap,) bool: live AND non-null keys
     row_live: jnp.ndarray       # (cap,) bool: live (incl. null-key rows)
     num_rows: jnp.ndarray       # int32
+    key_ordinals: Optional[List[int]] = None  # for post-match verification
+    null_safe: bool = False
 
 
 def _fingerprint64(batch: DeviceBatch, key_ordinals) -> jnp.ndarray:
@@ -88,7 +90,48 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
     sorted_batch = DeviceBatch(cols, batch.num_rows)
     return BuiltSide(sorted_batch, jnp.take(key, perm, axis=0),
                      jnp.take(matchable, perm, axis=0), s_live,
-                     batch.num_rows)
+                     batch.num_rows, list(key_ordinals), null_safe)
+
+
+def _pair_keys_equal(built: BuiltSide, b_idx: jnp.ndarray,
+                     probe: DeviceBatch, p_idx: jnp.ndarray,
+                     probe_ordinals: Sequence[int],
+                     base: jnp.ndarray) -> jnp.ndarray:
+    """Verify ACTUAL key equality for candidate (probe, build) pairs.
+
+    Fingerprint ranges are candidates only — a 64-bit collision (or a true
+    fingerprint landing on the sort sentinel) would otherwise silently join
+    wrong rows. The reference's cuDF hash join compares real keys after
+    hashing; this is that check, vectorized over the expanded pairs.
+    Float keys follow Spark join-key semantics (NaN==NaN, -0.0==0.0);
+    null-safe (<=>) joins treat NULL==NULL as a match.
+    """
+    from spark_rapids_tpu.columnar.batch import string_repad
+    eq = base
+    for bo, po in zip(built.key_ordinals, probe_ordinals):
+        bc = built.batch.columns[bo]
+        pc = probe.columns[po]
+        bv = jnp.take(bc.validity, b_idx, axis=0, mode="clip")
+        pv = jnp.take(pc.validity, p_idx, axis=0, mode="clip")
+        if bc.dtype.is_string:
+            w = max(bc.string_width, pc.string_width)
+            bcw, pcw = string_repad(bc, w), string_repad(pc, w)
+            bd = jnp.take(bcw.data, b_idx, axis=0, mode="clip")
+            pd = jnp.take(pcw.data, p_idx, axis=0, mode="clip")
+            bl = jnp.take(bcw.lengths, b_idx, axis=0, mode="clip")
+            pl = jnp.take(pcw.lengths, p_idx, axis=0, mode="clip")
+            data_eq = (bl == pl) & jnp.all(bd == pd, axis=1)
+        else:
+            bd = jnp.take(bc.data, b_idx, axis=0, mode="clip")
+            pd = jnp.take(pc.data, p_idx, axis=0, mode="clip")
+            data_eq = bd == pd
+            if jnp.issubdtype(bd.dtype, jnp.floating):
+                data_eq = data_eq | (jnp.isnan(bd) & jnp.isnan(pd))
+        if built.null_safe:
+            eq = eq & ((bv & pv & data_eq) | (~bv & ~pv))
+        else:
+            eq = eq & bv & pv & data_eq
+    return eq
 
 
 def probe_ranges(built: BuiltSide, probe: DeviceBatch,
@@ -164,15 +207,13 @@ class _JoinKernelMixin:
             if jt == "full" else None
         for pbatch in probe_iter:
             lo, counts, plive = probe_ranges(built, pbatch, probe_keys)
-            # Semi/anti need no expansion when there is no condition.
-            if jt in ("semi", "anti") and cond is None:
-                keep = (counts > 0) if jt == "semi" else (counts == 0)
-                yield pbatch.compact(keep & pbatch.row_mask())
-                continue
+            # (Semi/anti also go through expansion: candidate fingerprint
+            # ranges must be key-verified before deciding hit/miss.)
             total = int(jnp.sum(counts))
             out_cap = bucket_capacity(max(total, 1))
             out, covered = self._emit_expanded(
-                built, pbatch, lo, counts, plive, out_cap, build_is_right)
+                built, pbatch, lo, counts, plive, out_cap, build_is_right,
+                probe_keys)
             if covered_acc is not None and covered is not None:
                 covered_acc = covered_acc | covered
             yield out
@@ -190,7 +231,7 @@ class _JoinKernelMixin:
 
     def _emit_expanded(self, built: BuiltSide, pbatch: DeviceBatch,
                        lo, counts, plive, out_cap: int,
-                       build_is_right: bool):
+                       build_is_right: bool, probe_keys=None):
         """Expand matches for one probe batch. Returns (out_batch,
         covered_build_rows_or_None)."""
         jt = self.join_type
@@ -198,6 +239,8 @@ class _JoinKernelMixin:
         probe_cap = pbatch.capacity
         p, b, valid, total, _overflow = expand_pairs(lo, counts, out_cap,
                                                      probe_cap)
+        if built.key_ordinals is not None and probe_keys is not None:
+            valid = _pair_keys_equal(built, b, pbatch, p, probe_keys, valid)
         probe_cols = _gather_cols(pbatch, p, valid)
         build_cols = _gather_cols(built.batch, b, valid)
         if build_is_right:

@@ -71,11 +71,19 @@ def _orderable_u32_words(col: DeviceColumn) -> List[jnp.ndarray]:
             # Spark: NaN sorts greater than everything; canonical NaN bits
             # already sort above +inf after the transform.
             return [bits]
-        bits = jnp.asarray(col.data, jnp.float64).view(jnp.uint64)
-        neg = (bits >> jnp.uint64(63)) == 1
-        bits = jnp.where(neg, ~bits, bits | jnp.uint64(0x8000000000000000))
-        return [(bits >> jnp.uint64(32)).astype(jnp.uint32),
-                (bits & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)]
+        # float64: TPU's x64 emulation has no 64-bit bitcast, so the key
+        # stays in the FLOAT domain (argsort compares f64 directly):
+        #   [nan tier (u32), value (f64, NaNs zeroed), -0/+0 tiebreak].
+        x = jnp.asarray(col.data, jnp.float64)
+        nan = jnp.isnan(x)
+        nan_word = nan.astype(jnp.uint32)           # NaN sorts greatest
+        val = jnp.where(nan, jnp.float64(0.0), x)
+        negzero = (x == 0.0) & (1.0 / x < 0)
+        zero_word = jnp.where(x == 0.0,
+                              jnp.where(negzero, jnp.uint32(0),
+                                        jnp.uint32(1)),
+                              jnp.uint32(0))        # -0.0 before +0.0
+        return [nan_word, val, zero_word]
     if t.name in ("int64", "timestamp"):
         u = col.data.astype(jnp.int64).astype(jnp.uint64) ^ \
             jnp.uint64(0x8000000000000000)
@@ -92,14 +100,16 @@ def sort_key_passes(col: DeviceColumn, ascending: bool,
     ordering word. Descending keys get bit-flipped words."""
     words = _orderable_u32_words(col)
     if not ascending:
-        words = [~w for w in words]
+        # u32 words flip bitwise; float-domain passes flip by negation.
+        words = [jnp.negative(w) if jnp.issubdtype(w.dtype, jnp.floating)
+                 else ~w for w in words]
     # Null word: 0 sorts first. nulls_first -> nulls get 0, else 1-flip.
     if nulls_first:
         null_word = jnp.where(col.validity, jnp.uint32(1), jnp.uint32(0))
     else:
         null_word = jnp.where(col.validity, jnp.uint32(0), jnp.uint32(1))
     # Zero data words for nulls so null ordering is decided by null_word.
-    words = [jnp.where(col.validity, w, jnp.uint32(0)) for w in words]
+    words = [jnp.where(col.validity, w, jnp.zeros_like(w)) for w in words]
     return [null_word] + words
 
 
@@ -212,16 +222,31 @@ def segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,
         agg = jax.ops.segment_sum(masked, gid, num_segments=capacity)
     elif kind in ("min", "max"):
         if jnp.issubdtype(values.dtype, jnp.floating):
-            # Reduce in the IEEE total-order uint domain so NaN behaves as
-            # the greatest value (Spark ordering) instead of propagating.
-            bits, inv = _float_orderable(values)
-            fill = jnp.asarray(
-                jnp.iinfo(bits.dtype).max if kind == "min" else 0,
-                bits.dtype)
-            masked = jnp.where(validity, bits, fill)
-            red = jax.ops.segment_min if kind == "min" else \
-                jax.ops.segment_max
-            agg = inv(red(masked, gid, num_segments=capacity))
+            # Spark orders NaN greatest. Reduce in the float domain with
+            # NaNs masked out (bitcast-free — TPU's x64 emulation cannot
+            # bitcast f64): min ignores NaN unless the group is all-NaN;
+            # max is NaN whenever any valid NaN exists.
+            isnan = jnp.isnan(values)
+            real = validity & ~isnan
+            nanv = jnp.asarray(jnp.nan, values.dtype)
+            if kind == "min":
+                masked = jnp.where(real, values,
+                                   jnp.asarray(jnp.inf, values.dtype))
+                m = jax.ops.segment_min(masked, gid,
+                                        num_segments=capacity)
+                has_real = jax.ops.segment_sum(
+                    real.astype(jnp.int32), gid,
+                    num_segments=capacity) > 0
+                agg = jnp.where(has_real, m, nanv)
+            else:
+                masked = jnp.where(real, values,
+                                   jnp.asarray(-jnp.inf, values.dtype))
+                m = jax.ops.segment_max(masked, gid,
+                                        num_segments=capacity)
+                has_nan = jax.ops.segment_sum(
+                    (validity & isnan).astype(jnp.int32), gid,
+                    num_segments=capacity) > 0
+                agg = jnp.where(has_nan, nanv, m)
         else:
             masked = jnp.where(validity, values,
                                _identity_for(values.dtype, kind))
@@ -278,30 +303,6 @@ def segment_minmax_string(data: jnp.ndarray, lengths: jnp.ndarray,
     out_data = jnp.where(has_valid[:, None], out_data, 0)
     out_lens = jnp.where(has_valid, out_lens, 0)
     return out_data, has_valid, out_lens
-
-
-def _float_orderable(values: jnp.ndarray):
-    """Map floats to order-preserving unsigned ints; returns (bits, inverse).
-
-    NaN's canonical bit pattern lands above +inf, matching Spark's
-    NaN-is-greatest ordering."""
-    if values.dtype == jnp.float32:
-        u, sign = jnp.uint32, jnp.uint32(0x80000000)
-        bits = values.view(jnp.uint32)
-        shift = jnp.uint32(31)
-    else:
-        u, sign = jnp.uint64, jnp.uint64(0x8000000000000000)
-        bits = values.view(jnp.uint64)
-        shift = jnp.uint64(63)
-    neg = (bits >> shift) == 1
-    fwd = jnp.where(neg, ~bits, bits | sign)
-
-    def inverse(b):
-        was_pos = (b & sign) != 0
-        orig = jnp.where(was_pos, b & ~sign, ~b)
-        return orig.view(values.dtype)
-
-    return fwd, inverse
 
 
 def _identity_for(dtype, kind: str):

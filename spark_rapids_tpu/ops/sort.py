@@ -37,11 +37,12 @@ class SortOrder:
 
 def coalesce_to_single_batch(batches: List[DeviceBatch]) -> DeviceBatch:
     """Concatenate a partition's batches into one (RequireSingleBatch goal,
-    GpuCoalesceBatches.scala:120)."""
+    GpuCoalesceBatches.scala:120). Jitted so the scatter storm fuses."""
+    from spark_rapids_tpu.columnar.batch import jit_concat_batches
     if len(batches) == 1:
         return batches[0]
     total_cap = sum(b.capacity for b in batches)
-    return concat_batches(batches, bucket_capacity(total_cap))
+    return jit_concat_batches(batches, bucket_capacity(total_cap))
 
 
 def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]) -> DeviceBatch:

@@ -106,14 +106,24 @@ class ShuffleExchangeExec(Exec):
         self._ensure_bounds(ctx, device=True)
         n = self.partitioning.num_partitions
         buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
-        split_fn = lambda b: split_batch(
-            b, self.partitioning.partition_ids(b), n)
-        split = jax.jit(split_fn) if self.partitioning.jittable else split_fn
+        if self._split_jit is None:
+            split_fn = lambda b: split_batch(
+                b, self.partitioning.partition_ids(b), n)
+            self._split_jit = jax.jit(split_fn) \
+                if self.partitioning.jittable else split_fn
+        split = self._split_jit
+        from spark_rapids_tpu.memory.stores import (
+            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
         for cp in range(self.children[0].num_partitions(ctx)):
             for batch in self.children[0].execute_device(ctx, cp):
                 pieces = split(batch)
                 for p, piece in enumerate(pieces):
-                    buckets[p].append(piece)
+                    # Shuffle output is spillable (RapidsCachingWriter
+                    # inserts into the device store; shuffle spills FIRST
+                    # per SpillPriorities) — the bucket holds a handle,
+                    # not a pinned device batch.
+                    buckets[p].append(SpillableBatch(
+                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
         ctx.cache[key] = buckets
         return buckets
 
@@ -134,8 +144,19 @@ class ShuffleExchangeExec(Exec):
 
     # -- serving (the "reduce side") -----------------------------------------
     def execute_device(self, ctx, partition):
+        # Buckets stay registered (not freed) until ctx.close(): a plan can
+        # legitimately re-execute a partition (range-bounds sampling,
+        # broadcast probe re-runs). Consumed buckets carry the lowest spill
+        # priority, so they are the first evicted under pressure.
+        from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
         buckets = self._materialize_device(ctx)
-        yield from iter(buckets[partition])
+        for sb in buckets[partition]:
+            try:
+                yield sb.get()
+            finally:
+                # Runs on normal resume AND on early generator close, so an
+                # abandoned consumer (limit) never pins a batch as ACTIVE.
+                sb.release(PRIORITY_SHUFFLE_OUTPUT)
 
     def execute_host(self, ctx, partition):
         buckets = self._materialize_host(ctx)
@@ -183,12 +204,8 @@ class BroadcastExchangeExec(Exec):
         for cp in range(self.children[0].num_partitions(ctx)):
             hbs.extend(self.children[0].execute_host(ctx, cp))
         assert hbs, "broadcast of empty child"
-        cols = []
-        for ci, c0 in enumerate(hbs[0].columns):
-            data = np.concatenate([hb.columns[ci].data for hb in hbs])
-            val = np.concatenate([hb.columns[ci].validity for hb in hbs])
-            cols.append(HostColumn(c0.dtype, data, val))
-        merged = HostBatch(hbs[0].names, cols)
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        merged = concat_host_batches(hbs)
         ctx.cache[key] = merged
         return merged
 

@@ -163,9 +163,13 @@ def distributed_join_agg_step(mesh: Mesh, join_exec, agg_exec,
             out_cap = bucket_capacity(4 * (lex.capacity + rex.capacity))
         p, b, valid, num_rows, overflow = J.expand_pairs(
             lo, counts, out_cap, lex.capacity)
+        valid = J._pair_keys_equal(
+            built, b, lex, p, [k.ordinal for k in join_exec.left_keys],
+            valid)
         probe_cols = J._gather_cols(lex, p, valid)
         build_cols = J._gather_cols(built.batch, b, valid)
-        pairs = DeviceBatch(tuple(probe_cols) + tuple(build_cols), num_rows)
+        pairs = DeviceBatch(
+            tuple(probe_cols) + tuple(build_cols), num_rows).compact(valid)
         partial = agg_exec._update_batch(pairs, jnp.asarray(0, jnp.int64))
         pids = agg_partitioning.partition_ids(partial)
         exchanged = all_to_all_exchange(partial, pids, n, axis)

@@ -234,8 +234,13 @@ class PhysicalPlan:
 
     def collect(self, ctx=None):
         from spark_rapids_tpu.ops.base import ExecContext
+        owned = ctx is None
         ctx = ctx or ExecContext(self.conf)
-        return self.root.collect(ctx, device=self.root_on_device)
+        try:
+            return self.root.collect(ctx, device=self.root_on_device)
+        finally:
+            if owned:
+                ctx.close()
 
     def host_fallback_nodes(self) -> List[str]:
         out = []
@@ -257,6 +262,8 @@ class Planner:
 
     # -- public --------------------------------------------------------------
     def plan(self, logical: LogicalPlan) -> PhysicalPlan:
+        from spark_rapids_tpu.plan.pruning import prune_columns
+        logical = prune_columns(logical)
         meta = wrap_and_tag(logical, self.conf)
         if self.conf.explain in ("ALL", "NOT_ON_GPU"):
             print("\n".join(meta.explain_lines(

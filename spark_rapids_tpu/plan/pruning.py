@@ -1,0 +1,129 @@
+"""Column pruning: push required-column sets down to file scans.
+
+The reference gets this for free from Spark (FileSourceScanExec's output
+attributes are pruned by Catalyst before GpuOverrides sees the plan, and
+GpuParquetScan reads only the requested schema — GpuParquetScan.scala:84
+``readDataSchema``). Standalone, this engine owns the frontend, so the
+planner runs this rewrite before tag/convert: walk the logical tree
+computing which column names each subtree must produce, and replace
+``FileScan`` leaves with copies whose ``source_schema`` keeps only the
+required fields (file order preserved). The scan layer then asks pyarrow
+for just those columns, skipping the host decode of everything else.
+
+Only scans narrow; Project/Aggregate/Join output widths are left alone so
+resolution-by-name above them is unaffected.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.logical import Column, LogicalPlan
+
+
+def refs_of(c: Column, out: Set[str]) -> Set[str]:
+    """Collect column names referenced by an untyped Column AST."""
+    node = c.node
+    if node[0] == "ref":
+        out.add(node[1])
+        return out
+    for x in node[1:]:
+        if isinstance(x, Column):
+            refs_of(x, out)
+        elif isinstance(x, tuple):
+            for y in x:
+                if isinstance(y, Column):
+                    refs_of(y, out)
+                elif isinstance(y, tuple):
+                    for z in y:
+                        if isinstance(z, Column):
+                            refs_of(z, out)
+    return out
+
+
+def prune_columns(plan: LogicalPlan) -> LogicalPlan:
+    """Entry point: rewrite ``plan`` with column-pruned file scans."""
+    return _prune(plan, None)
+
+
+def _prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
+    # required == None means "every column of this subtree's schema".
+    if isinstance(plan, L.FileScan):
+        if required is None:
+            return plan
+        kept = tuple(f for f in plan.source_schema if f[0] in required)
+        if not kept or len(kept) == len(plan.source_schema):
+            return plan
+        return L.FileScan(plan.fmt, plan.paths, kept, plan.options)
+    if isinstance(plan, (L.InMemoryScan, L.LogicalRange)):
+        return plan
+    if isinstance(plan, L.LogicalFilter):
+        child_req = None if required is None else \
+            refs_of(plan.condition, set(required))
+        return L.LogicalFilter(_prune(plan.child, child_req),
+                               plan.condition)
+    if isinstance(plan, L.LogicalProject):
+        # Drop projections nothing above references (a with_column chain
+        # passes every source column through; keeping them would defeat
+        # scan pruning below), then require only what the kept ones read.
+        projections = plan.projections
+        if required is not None:
+            kept = [(n, c) for n, c in projections if n in required]
+            if kept:
+                projections = kept
+        child_req: Set[str] = set()
+        for _, c in projections:
+            refs_of(c, child_req)
+        return L.LogicalProject(_prune(plan.child, child_req),
+                                projections)
+    if isinstance(plan, L.LogicalAggregate):
+        child_req = set()
+        for _, c in plan.group_by:
+            refs_of(c, child_req)
+        for _, c in plan.aggregates:
+            refs_of(c, child_req)
+        return L.LogicalAggregate(_prune(plan.child, child_req),
+                                  plan.group_by, plan.aggregates)
+    if isinstance(plan, L.LogicalSort):
+        child_req = None
+        if required is not None:
+            child_req = set(required)
+            for o in plan.orders:
+                inner = o.node[1] if o.node[0] == "sortorder" else o
+                refs_of(inner, child_req)
+        return L.LogicalSort(_prune(plan.child, child_req), plan.orders)
+    if isinstance(plan, L.LogicalLimit):
+        return L.LogicalLimit(_prune(plan.child, required), plan.n)
+    if isinstance(plan, L.LogicalRepartition):
+        child_req = None
+        if required is not None:
+            child_req = set(required)
+            for k in (plan.keys or []):
+                refs_of(k, child_req)
+        return L.LogicalRepartition(_prune(plan.child, child_req),
+                                    plan.num_partitions, plan.keys)
+    if isinstance(plan, L.LogicalUnion):
+        # Union children flow positionally: pruning them independently
+        # could leave siblings with mismatched schemas. Keep full width.
+        return L.LogicalUnion(*[_prune(c, None)
+                                for c in plan.children])
+    if isinstance(plan, L.LogicalJoin):
+        left, right = plan.children
+        if required is None:
+            lreq = rreq = None
+        else:
+            needed = set(required)
+            for k in plan.left_keys + plan.right_keys:
+                refs_of(k, needed)
+            if plan.condition is not None:
+                refs_of(plan.condition, needed)
+            lnames = {n for n, _ in left.schema}
+            rnames = {n for n, _ in right.schema}
+            lreq = needed & lnames
+            rreq = needed & rnames
+        return L.LogicalJoin(_prune(left, lreq), _prune(right, rreq),
+                             plan.left_keys, plan.right_keys,
+                             plan.join_type, plan.condition,
+                             plan.strategy)
+    return plan

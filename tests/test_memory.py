@@ -70,7 +70,7 @@ class TestCatalogSpill:
         # Re-acquire: comes back to device, bit-identical.
         restored = cat.acquire_batch(ids[0])
         assert cat.tier_of(ids[0]) == StorageTier.DEVICE
-        orig = device_to_host(make_batch(1)).to_pylist()
+        orig = device_to_host(make_batch(0)).to_pylist()
         assert device_to_host(restored).to_pylist() == orig
         cat.close()
 
