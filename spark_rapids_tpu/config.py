@@ -237,6 +237,12 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
 METRICS_ENABLED = conf("spark.rapids.sql.metrics.enabled").doc(
     "Collect per-operator metrics (rows/batches/time).").boolean(True)
 
+MESH_ENABLED = conf("spark.rapids.sql.mesh.enabled").doc(
+    "Lower hash shuffles to collective all_to_all exchanges over the "
+    "jax.sharding.Mesh of all visible devices (ICI shuffle; ref: "
+    "SURVEY.md §2.6 TPU mapping). Off = single-process materialized "
+    "exchange.").boolean(False)
+
 DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
     "Explicit HBM budget for the buffer catalog in bytes; 0 derives it "
     "from allocFraction of the visible device memory (ref: RMM pool "

@@ -1,0 +1,165 @@
+"""GenerateExec: explode / posexplode (ref: GpuGenerateExec.scala, 194
+LoC — per-row repeat of companion columns + flattened array elements).
+
+The engine's type system is scalar-only (same envelope as the reference's
+GpuOverrides.isSupportedType gate), so the supported generator is
+``explode(array(e1, .., ek))`` — an inline array of K element expressions
+per row, Spark's array-literal explode. Row i expands to up to K output
+rows (NULL elements dropped unless ``outer``); companion columns repeat.
+
+TPU shape story: K is static, so the expansion is a fixed gather — output
+capacity = K * input capacity, no size sync at all (unlike joins). A
+compaction pass drops null elements when not outer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity, string_repad)
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
+    as_host_column
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+
+
+class GenerateExec(Exec):
+    """explode/posexplode of an inline array over each input row."""
+
+    def __init__(self, child: Exec, elements: Sequence[Expression],
+                 position: bool = False, outer: bool = False,
+                 element_name: str = "col", skip_nulls: bool = False):
+        """``skip_nulls`` drops NULL elements (emulating variable-length
+        arrays via null padding); ``outer`` then still emits one all-NULL
+        row for rows whose every element is NULL (explode_outer). With
+        skip_nulls=False (Spark's semantics for inline arrays, which are
+        never null) every row emits exactly K output rows."""
+        super().__init__(child)
+        assert elements, "explode of empty array"
+        self.elements = list(elements)
+        self.position = position
+        self.outer = outer
+        self.skip_nulls = skip_nulls
+        self.element_name = element_name
+        t0 = self.elements[0].data_type()
+        for e in self.elements[1:]:
+            assert e.data_type() == t0, "array elements must share a type"
+        self._elem_type = t0
+        self._jit = None
+
+    @property
+    def schema(self) -> Schema:
+        base = list(self.children[0].schema)
+        if self.position:
+            base.append(("pos", dt.INT32))
+        base.append((self.element_name, self._elem_type))
+        return tuple(base)
+
+    def _kernel(self, batch: DeviceBatch) -> DeviceBatch:
+        cap = batch.capacity
+        k = len(self.elements)
+        out_cap = bucket_capacity(cap * k)
+        # Element columns evaluated on the input batch.
+        elems = [as_device_column(e.eval(batch), batch)
+                 for e in self.elements]
+        if self._elem_type.is_string:
+            w = max(c.string_width for c in elems)
+            elems = [string_repad(c, w) for c in elems]
+        # Output slot s (< cap*k) maps to (row = s // k, element = s % k):
+        # each input row's K elements are adjacent, Spark's explode order.
+        slots = jnp.arange(out_cap, dtype=jnp.int32)
+        row = slots // k
+        ei = slots % k
+        live = jnp.take(batch.row_mask(), jnp.clip(row, 0, cap - 1),
+                        axis=0) & (slots < cap * k)
+        # Element value/validity per slot: select among the K columns.
+        edata = jnp.stack([c.data for c in elems])        # (k, cap, [w])
+        evalid = jnp.stack([c.validity for c in elems])   # (k, cap)
+        rr = jnp.clip(row, 0, cap - 1)
+        if self._elem_type.is_string:
+            val = edata[ei, rr]                           # (out_cap, w)
+            elens = jnp.stack([c.lengths for c in elems])
+            lens = elens[ei, rr]
+        else:
+            val = edata[ei, rr]
+            lens = None
+        vvalid = evalid[ei, rr] & live
+        if not self.skip_nulls:
+            keep = live
+        else:
+            keep = live & vvalid
+            if self.outer:
+                # explode_outer: a row with zero surviving elements still
+                # emits one all-NULL element row (at slot ei == 0).
+                any_valid = jnp.any(jnp.stack(
+                    [c.validity for c in elems]), axis=0)
+                none_valid = ~jnp.take(any_valid, rr, axis=0)
+                keep = keep | (live & none_valid & (ei == 0))
+        # Companion columns gathered by source row.
+        out_cols: List[DeviceColumn] = []
+        for c in batch.columns:
+            out_cols.append(c.gather(rr, live))
+        if self.position:
+            out_cols.append(DeviceColumn(
+                dt.INT32, jnp.where(live, ei, 0).astype(jnp.int32), live))
+        if self._elem_type.is_string:
+            out_cols.append(DeviceColumn(
+                self._elem_type, jnp.where(vvalid[:, None], val, 0),
+                vvalid, jnp.where(vvalid, lens, 0)))
+        else:
+            out_cols.append(DeviceColumn(
+                self._elem_type,
+                jnp.where(vvalid, val, jnp.zeros((), val.dtype)), vvalid))
+        expanded = DeviceBatch(tuple(out_cols),
+                               jnp.asarray(cap * k, jnp.int32))
+        # Dense rows first: compact away dropped slots (padding + non-outer
+        # nulls). keep already excludes dead input rows.
+        return expanded.compact(keep)
+
+    def execute_device(self, ctx, partition):
+        m = ctx.metrics_for(self)
+        if self._jit is None:
+            self._jit = jax.jit(self._kernel)
+        for batch in self.children[0].execute_device(ctx, partition):
+            with timed(m):
+                out = self._jit(batch)
+            m.add("numOutputBatches", 1)
+            yield out
+
+    # -- host oracle ---------------------------------------------------------
+    def execute_host(self, ctx, partition):
+        for hb in self.children[0].execute_host(ctx, partition):
+            elem_lists = [as_host_column(e.eval_host(hb), hb).to_list()
+                          for e in self.elements]
+            comp = [c.to_list() for c in hb.columns]
+            rows = []
+            for i in range(hb.num_rows):
+                emitted = False
+                for j, el in enumerate(elem_lists):
+                    v = el[i]
+                    if v is None and self.skip_nulls:
+                        continue
+                    r = [cl[i] for cl in comp]
+                    if self.position:
+                        r.append(j)
+                    r.append(v)
+                    rows.append(tuple(r))
+                    emitted = True
+                if self.skip_nulls and self.outer and not emitted:
+                    r = [cl[i] for cl in comp]
+                    if self.position:
+                        r.append(0)
+                    r.append(None)
+                    rows.append(tuple(r))
+            names = tuple(n for n, _ in self.schema)
+            cols = []
+            for ci, (_, t) in enumerate(self.schema):
+                cols.append(HostColumn.from_values(
+                    t, [r[ci] for r in rows]))
+            yield HostBatch(names, cols)

@@ -44,12 +44,19 @@ UNBOUNDED = None
 
 @dataclasses.dataclass
 class WindowFrame:
-    """ROWS frame bounds; None = unbounded. Spark's default (RANGE
-    UNBOUNDED..CURRENT with peers) is ``running=True``."""
+    """Frame bounds; None = unbounded. Spark's default (RANGE
+    UNBOUNDED..CURRENT with peers) is ``running=True``.
+
+    ``range_interval=True`` makes preceding/following VALUE offsets over
+    the single integer-typed order column (date days / timestamp micros)
+    instead of row counts — the reference's RANGE-interval frame envelope
+    (GpuWindowExpression.scala:114-151: one non-null date/time order
+    column, ascending, day intervals)."""
 
     preceding: Optional[int] = UNBOUNDED
     following: Optional[int] = 0
     running_with_peers: bool = False
+    range_interval: bool = False
 
 
 @dataclasses.dataclass
@@ -255,12 +262,28 @@ def _eval_one(batch, wx, perm, s_live, new_part, new_peer, seg_start, gid,
         return data, valid
     if isinstance(fn, WindowAgg):
         return _eval_window_agg(batch, fn, perm, s_live, new_part,
-                                new_peer, seg_start, gid, idx, cap)
+                                new_peer, seg_start, gid, idx, cap,
+                                wx.spec)
     raise NotImplementedError(type(fn).__name__)
 
 
+def _seg_lower_bound(oval, lo0, hi0, target, cap, inclusive):
+    """Vectorized per-row binary search within [lo0, hi0): first index j
+    with oval[j] >= target (inclusive=False: > target). oval is ascending
+    inside each segment; bounds confine the search to the row's segment."""
+    lo, hi = lo0, hi0
+    for _ in range(int(np.ceil(np.log2(max(cap, 2)))) + 1):
+        mid = (lo + hi) // 2
+        v = jnp.take(oval, jnp.clip(mid, 0, cap - 1), axis=0)
+        go_right = (v <= target) if inclusive else (v < target)
+        active = lo < hi
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
 def _eval_window_agg(batch, fn: WindowAgg, perm, s_live, new_part, new_peer,
-                     seg_start, gid, idx, cap):
+                     seg_start, gid, idx, cap, spec=None):
     if fn.child is not None:
         col = as_device_column(fn.child.eval(batch), batch)
         sdata = jnp.take(col.data, perm, axis=0)
@@ -275,6 +298,11 @@ def _eval_window_agg(batch, fn: WindowAgg, perm, s_live, new_part, new_peer,
             not frame.running_with_peers:
         # Whole partition: segment reduce, broadcast back by gid.
         return _whole_partition(fn, sdata, svalid, gid, cap)
+
+    if frame.range_interval:
+        return _eval_range_interval(batch, fn, sdata, svalid, perm,
+                                    s_live, new_part, seg_start, idx,
+                                    cap, spec)
 
     # Running / ROWS frames via cumulative sums.
     if fn.kind in ("sum", "avg", "count"):
@@ -357,6 +385,67 @@ def _eval_window_agg(batch, fn: WindowAgg, perm, s_live, new_part, new_peer,
             ns = jnp.take(ns, end, axis=0)
         return scanned, s_live & (ns > 0)
     raise NotImplementedError(fn.kind)
+
+
+def _eval_range_interval(batch, fn: WindowAgg, sdata, svalid, perm,
+                         s_live, new_part, seg_start, idx, cap, spec):
+    """RANGE BETWEEN (val - preceding) AND (val + following): frame bounds
+    found by per-row segment-confined binary search over the (sorted)
+    order-column values, then cumsum prefix differences — the TPU
+    replacement for cuDF's range rolling windows
+    (GpuWindowExpression.scala:114-151's envelope: ONE non-null integer
+    date/time order column, ascending)."""
+    assert spec is not None and len(spec.order_by) == 1, \
+        "range-interval frames require exactly one order column"
+    o = spec.order_by[0]
+    assert o.ascending, "range-interval frames require ascending order"
+    if fn.kind not in ("sum", "avg", "count"):
+        raise NotImplementedError(
+            "range-interval min/max window frames")
+    ocol = as_device_column(o.child.eval(batch), batch)
+    oval = jnp.take(ocol.data, perm, axis=0).astype(jnp.int64)
+    seg_end = _run_ends(jnp.concatenate(
+        [new_part[1:], jnp.ones((1,), jnp.bool_)]), cap)
+    cur = oval
+    if fn.frame.preceding is UNBOUNDED:
+        start = seg_start
+    else:
+        # first index in segment with oval >= cur - preceding
+        start = _seg_lower_bound(oval, seg_start, seg_end + 1,
+                                 cur - fn.frame.preceding, cap,
+                                 inclusive=False)
+    if fn.frame.following is UNBOUNDED:
+        end = seg_end
+    else:
+        # last index in segment with oval <= cur + following
+        end = _seg_lower_bound(oval, seg_start, seg_end + 1,
+                               cur + fn.frame.following, cap,
+                               inclusive=True) - 1
+    t = fn.result_type()
+    acc_t = jnp.float64 if t.is_floating or fn.kind == "avg" else jnp.int64
+    vals = svalid.astype(jnp.int64) if fn.kind == "count" else \
+        jnp.where(svalid, sdata.astype(acc_t), jnp.zeros((), acc_t))
+    cum = jnp.cumsum(vals)
+    cnt = jnp.cumsum(svalid.astype(jnp.int64))
+
+    def upto(i):
+        c = jnp.take(cum, jnp.clip(i, 0, cap - 1), axis=0)
+        n = jnp.take(cnt, jnp.clip(i, 0, cap - 1), axis=0)
+        return jnp.where(i < 0, 0, c), jnp.where(i < 0, 0, n)
+
+    c_end, n_end = upto(end)
+    c_before, n_before = upto(start - 1)
+    s = c_end - c_before
+    n = n_end - n_before
+    empty = end < start
+    s = jnp.where(empty, 0, s)
+    n = jnp.where(empty, 0, n)
+    if fn.kind == "count":
+        return s.astype(jnp.int64), s_live
+    if fn.kind == "avg":
+        safe = jnp.where(n > 0, n, 1)
+        return s / safe.astype(jnp.float64), s_live & (n > 0)
+    return s.astype(t.np_dtype), s_live & (n > 0)
 
 
 def _whole_partition(fn: WindowAgg, sdata, svalid, gid, cap):
@@ -483,8 +572,9 @@ def _host_window(hb: HostBatch, exprs, schema) -> HostBatch:
                     prev = ok
                 else:
                     peers.append(peers[-1])
+            ovals = ocols[0][0] if ocols else None
             out_cols[xi] = _host_eval_fn(
-                wx.fn, idxs, peers, ccol, out_cols[xi])
+                wx.fn, idxs, peers, ccol, out_cols[xi], ovals)
     cols = list(hb.columns)
     for xi, wx in enumerate(exprs):
         t = wx.fn.result_type()
@@ -492,7 +582,7 @@ def _host_window(hb: HostBatch, exprs, schema) -> HostBatch:
     return HostBatch(tuple(n_ for n_, _ in schema), cols)
 
 
-def _host_eval_fn(fn, idxs, peers, ccol, out):
+def _host_eval_fn(fn, idxs, peers, ccol, out, ovals=None):
     npart = len(idxs)
     if isinstance(fn, RowNumber):
         for r, i in enumerate(idxs):
@@ -525,6 +615,21 @@ def _host_eval_fn(fn, idxs, peers, ccol, out):
             elif frame.preceding is UNBOUNDED and \
                     frame.following is UNBOUNDED:
                 lo, hi = 0, npart - 1
+            elif frame.range_interval:
+                cur = ovals[i]
+                lo, hi = 0, npart - 1
+                if frame.preceding is not UNBOUNDED:
+                    lo = npart
+                    for s in range(npart):
+                        if ovals[idxs[s]] >= cur - frame.preceding:
+                            lo = s
+                            break
+                if frame.following is not UNBOUNDED:
+                    hi = -1
+                    for s in range(npart - 1, -1, -1):
+                        if ovals[idxs[s]] <= cur + frame.following:
+                            hi = s
+                            break
             else:
                 lo = 0 if frame.preceding is UNBOUNDED else \
                     max(0, r - frame.preceding)

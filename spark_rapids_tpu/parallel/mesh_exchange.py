@@ -1,0 +1,162 @@
+"""Planner-integrated collective shuffle: ShuffleExchangeExec lowered onto
+a jax.sharding.Mesh (VERDICT r1 item 4).
+
+When ``spark.rapids.sql.mesh.enabled`` is on, the planner emits
+``MeshExchangeExec`` for hash shuffles instead of the single-process
+materialized exchange: child partitions become one uniform-shape shard per
+mesh device, ONE jitted ``shard_map`` program runs the split +
+``jax.lax.all_to_all`` + concat (the ICI collective replacing the
+reference's UCX pull protocol — SURVEY.md §2.6 TPU mapping,
+GpuShuffleExchangeExec.scala:69,145), and each output partition serves its
+device's post-exchange shard to the normal per-partition operator stream
+above. Operators (aggregate final stage, shuffled join) compose unchanged.
+
+Single real chip degenerates to n=1; the 8-virtual-CPU-device mesh in
+tests/conftest.py exercises the real collective path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from spark_rapids_tpu.columnar.batch import (
+    DeviceBatch, DeviceColumn, bucket_capacity, string_repad)
+from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.parallel import mesh as M
+from spark_rapids_tpu.parallel.mesh_compat import shard_map
+from spark_rapids_tpu.parallel.partitioning import Partitioning
+
+
+def mesh_for(ctx: ExecContext):
+    """One mesh per query context (all visible devices)."""
+    m = ctx.cache.get("mesh:singleton")
+    if m is None:
+        m = M.make_mesh()
+        ctx.cache["mesh:singleton"] = m
+    return m
+
+
+def mesh_size() -> int:
+    return len(jax.devices())
+
+
+def _uniform_shards(batches_per_dev: List[List[DeviceBatch]],
+                    schema: Schema) -> List[DeviceBatch]:
+    """Coalesce each device's batches and pad all shards to one common
+    capacity + per-column string width (shard_map needs uniform shapes)."""
+    from spark_rapids_tpu.ops.sort import coalesce_to_single_batch
+    shards = []
+    for blist in batches_per_dev:
+        if blist:
+            shards.append(coalesce_to_single_batch(blist))
+        else:
+            shards.append(None)
+    caps = [s.capacity for s in shards if s is not None]
+    cap = bucket_capacity(max(caps)) if caps else 8
+    widths = []
+    for ci, (_, t) in enumerate(schema):
+        if t.is_string:
+            ws = [s.columns[ci].string_width
+                  for s in shards if s is not None]
+            widths.append(max(ws) if ws else 8)
+        else:
+            widths.append(None)
+    out = []
+    for s in shards:
+        if s is None:
+            cols = tuple(
+                DeviceColumn.full_null(t, cap, widths[ci] or 8)
+                for ci, (_, t) in enumerate(schema))
+            out.append(DeviceBatch(cols, jnp.asarray(0, jnp.int32)))
+            continue
+        cols = []
+        for ci, c in enumerate(s.columns):
+            if c.dtype.is_string and c.string_width != widths[ci]:
+                c = string_repad(c, widths[ci])
+            cols.append(c)
+        s = DeviceBatch(tuple(cols), s.num_rows)
+        if s.capacity != cap:
+            idx = jnp.arange(cap, dtype=jnp.int32)
+            s = s.gather(idx, s.num_rows)
+        out.append(s)
+    return out
+
+
+class MeshExchangeExec(Exec):
+    """Hash shuffle over the device mesh as one collective program."""
+
+    def __init__(self, child: Exec, partitioning: Partitioning):
+        super().__init__(child)
+        self.partitioning = partitioning
+        self._step = None
+
+    @property
+    def schema(self) -> Schema:
+        return self.children[0].schema
+
+    def num_partitions(self, ctx) -> int:
+        return self.partitioning.num_partitions
+
+    def _build_step(self, mesh, n: int):
+        part = self.partitioning
+
+        def local(stacked):
+            b = jax.tree.map(lambda x: x[0], stacked)
+            out = M.all_to_all_exchange(b, part.partition_ids(b), n)
+            return jax.tree.map(lambda x: x[None], out)
+
+        return jax.jit(shard_map(local, mesh, in_specs=(P(M.DATA_AXIS),),
+                                 out_specs=P(M.DATA_AXIS)))
+
+    def _materialize(self, ctx) -> List[DeviceBatch]:
+        key = f"meshx:{id(self):x}"
+        if key in ctx.cache:
+            return ctx.cache[key]
+        m = ctx.metrics_for(self)
+        mesh = mesh_for(ctx)
+        n = mesh.devices.size
+        assert n == self.partitioning.num_partitions, \
+            "mesh exchange partition count must equal mesh size"
+        # Deal child partitions onto devices round-robin.
+        per_dev: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        child = self.children[0]
+        for cp in range(child.num_partitions(ctx)):
+            for batch in child.execute_device(ctx, cp):
+                per_dev[cp % n].append(batch)
+        with timed(m, "shuffleTime"):
+            shards = _uniform_shards(per_dev, self.schema)
+            stacked = M.shard_batches(mesh, shards)
+            if self._step is None:
+                self._step = self._build_step(mesh, n)
+            out = self._step(stacked)
+        # Slice device i's post-exchange shard back out as partition i.
+        parts = [jax.tree.map(lambda x, i=i: x[i], out) for i in range(n)]
+        ctx.cache[key] = parts
+        return parts
+
+    def execute_device(self, ctx, partition):
+        parts = self._materialize(ctx)
+        yield parts[partition]
+
+    def execute_host(self, ctx, partition):
+        # Host engine has no mesh; fall back to the materialized exchange
+        # semantics (same results, used only by the oracle).
+        from spark_rapids_tpu.parallel.partitioning import split_host_batch
+        key = f"meshx-host:{id(self):x}"
+        if key not in ctx.cache:
+            n = self.partitioning.num_partitions
+            buckets = [[] for _ in range(n)]
+            child = self.children[0]
+            for cp in range(child.num_partitions(ctx)):
+                for hb in child.execute_host(ctx, cp):
+                    pids = self.partitioning.partition_ids_host(hb)
+                    for p, piece in enumerate(
+                            split_host_batch(hb, pids, n)):
+                        buckets[p].append(piece)
+            ctx.cache[key] = buckets
+        yield from iter(ctx.cache[key][partition])

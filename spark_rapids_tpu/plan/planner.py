@@ -290,7 +290,23 @@ class Planner:
             else DeviceToHostExec(child_exec)
 
     def _shuffle_partitions(self) -> int:
+        if self._mesh_enabled():
+            from spark_rapids_tpu.parallel.mesh_exchange import mesh_size
+            return mesh_size()
         return self.conf.get(C.SHUFFLE_PARTITIONS)
+
+    def _mesh_enabled(self) -> bool:
+        return bool(self.conf.get(C.MESH_ENABLED))
+
+    def _hash_exchange(self, child: Exec, keys, n: int) -> Exec:
+        """Hash shuffle: collective mesh exchange when a mesh is
+        configured, else the materialized single-process exchange."""
+        part = HashPartitioning(keys, n)
+        if self._mesh_enabled():
+            from spark_rapids_tpu.parallel.mesh_exchange import \
+                MeshExchangeExec
+            return MeshExchangeExec(child, part)
+        return ShuffleExchangeExec(child, part)
 
     def _convert(self, meta: NodeMeta) -> Tuple[Exec, bool]:
         plan = meta.plan
@@ -331,6 +347,12 @@ class Planner:
             child = self._bridge(child, cdev, want_dev)
             if plan.keys:
                 keys = [resolve(k, plan.child.schema) for k in plan.keys]
+                if self._mesh_enabled():
+                    from spark_rapids_tpu.parallel.mesh_exchange import \
+                        MeshExchangeExec, mesh_size
+                    return MeshExchangeExec(
+                        child, HashPartitioning(keys, mesh_size())), \
+                        want_dev
                 part = HashPartitioning(keys, plan.num_partitions)
             else:
                 part = RoundRobinPartitioning(plan.num_partitions)
@@ -376,10 +398,10 @@ class Planner:
         if nkeys:
             keys = [BoundReference(i, e.data_type())
                     for i, (_, e) in enumerate(group_by)]
-            part = HashPartitioning(keys, self._shuffle_partitions())
+            ex = self._hash_exchange(partial, keys,
+                                     self._shuffle_partitions())
         else:
-            part = SinglePartitioning()
-        ex = ShuffleExchangeExec(partial, part)
+            ex = ShuffleExchangeExec(partial, SinglePartitioning())
         final_groups = [
             (n, BoundReference(i, e.data_type()))
             for i, (n, e) in enumerate(group_by)]
@@ -410,7 +432,7 @@ class Planner:
             return BroadcastHashJoinExec(
                 lch, rch, lkeys, rkeys, plan.join_type, cond), want_dev
         n = self._shuffle_partitions()
-        lex = ShuffleExchangeExec(lch, HashPartitioning(lkeys, n))
-        rex = ShuffleExchangeExec(rch, HashPartitioning(rkeys, n))
+        lex = self._hash_exchange(lch, lkeys, n)
+        rex = self._hash_exchange(rch, rkeys, n)
         return ShuffledHashJoinExec(
             lex, rex, lkeys, rkeys, plan.join_type, cond), want_dev

@@ -1,0 +1,76 @@
+"""Planner-lowered collective shuffle: full DataFrame queries execute over
+the 8-virtual-CPU-device mesh (conftest) with mesh.enabled, and results
+match the single-process exchange and the host oracle (VERDICT r1 item 4).
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.plan.logical import agg_count, agg_sum, col
+
+
+def _session(mesh: bool):
+    s = TpuSession()
+    s.set("spark.rapids.sql.mesh.enabled", mesh)
+    return s
+
+
+def _tables(s, n=800, parts=5):
+    rng = np.random.default_rng(11)
+    facts = s.create_dataframe(
+        {"k": rng.integers(0, 37, n).tolist(),
+         "v": rng.integers(-100, 100, n).tolist(),
+         "tag": [f"t{i % 7}" for i in range(n)]},
+        [("k", dt.INT64), ("v", dt.INT64), ("tag", dt.STRING)],
+        num_partitions=parts)
+    dims = s.create_dataframe(
+        {"dk": list(range(37)), "w": [i * 10 for i in range(37)]},
+        [("dk", dt.INT64), ("w", dt.INT64)], num_partitions=2)
+    return facts, dims
+
+
+def _q_groupby(s):
+    facts, _ = _tables(s)
+    return facts.group_by("k").agg(
+        agg_sum(col("v")).alias("sv"), agg_count().alias("n")) \
+        .order_by("k")
+
+
+def _q_join_agg(s):
+    facts, dims = _tables(s)
+    j = facts.join_on(dims, ["k"], ["dk"], strategy="shuffle")
+    return j.group_by("tag").agg(
+        agg_sum(col("v") + col("w")).alias("s"),
+        agg_count().alias("n")).order_by("tag")
+
+
+@pytest.mark.parametrize("qf", [_q_groupby, _q_join_agg],
+                         ids=["groupby", "join_agg"])
+def test_mesh_matches_single_process(qf):
+    mesh_rows = qf(_session(True)).collect()
+    single_rows = qf(_session(False)).collect()
+    host_rows = qf(_session(False)).collect_host()
+    assert mesh_rows == single_rows
+    assert mesh_rows == host_rows
+
+
+def test_mesh_exchange_in_plan():
+    from spark_rapids_tpu.parallel.mesh_exchange import MeshExchangeExec
+    q = _q_groupby(_session(True))
+    phys = q._physical()
+
+    def find(e):
+        if isinstance(e, MeshExchangeExec):
+            return True
+        return any(find(c) for c in e.children)
+    assert find(phys.root), "mesh exchange not planned"
+
+
+def test_mesh_repartition():
+    s = _session(True)
+    facts, _ = _tables(s)
+    got = sorted(facts.repartition(8, "k").collect())
+    want = sorted(facts.collect())
+    assert got == want
