@@ -4,4 +4,5 @@ from spark_rapids_tpu.api.dataframe import (    # noqa: F401
     DataFrame, DataFrameReader, GroupedData, TpuSession)
 from spark_rapids_tpu.plan.logical import (     # noqa: F401
     agg_avg, agg_count, agg_first, agg_last, agg_max, agg_min, agg_sum,
-    col, concat, lit_col, lower, upper, when)
+    col, concat, input_file_name, lit_col, lower, monotonically_increasing_id,
+    rand, spark_partition_id, upper, when)

@@ -32,3 +32,6 @@ from spark_rapids_tpu.exprs.strings import (      # noqa: F401
     StartsWith, StringLocate, StringReplace, StringTrim, StringTrimLeft,
     StringTrimRight, Substring, Upper)
 from spark_rapids_tpu.exprs.hash import Murmur3Hash  # noqa: F401
+from spark_rapids_tpu.exprs.nondeterministic import (  # noqa: F401
+    EvalContext, InputFileName, MonotonicallyIncreasingID, Rand,
+    SparkPartitionID, eval_context, needs_eval_context)

@@ -1,0 +1,236 @@
+"""Nondeterministic / task-context expressions.
+
+Ref: GpuSparkPartitionID.scala:58, GpuMonotonicallyIncreasingID.scala:75,
+GpuRandomExpressions.scala:75, GpuInputFileBlock.scala — expressions whose
+value depends on the task context (partition index, row position within the
+partition, current input file) rather than only on column inputs.
+
+The engine threads that context through an ``EvalContext`` (a contextvar set
+by the evaluating operator around each batch). Under jit the partition id and
+row base are *traced* scalars, so one compiled program serves every
+partition/batch — the TPU analog of the reference reading
+``TaskContext.getPartitionId()`` per task.
+
+``Rand`` matches Spark's distribution (uniform [0,1), seeded per
+(seed, partition)) but not Spark's bit-exact XORShift sequence — the same
+deviation the reference takes (GpuRandomExpressions uses cuDF's RNG, not
+Spark's). Device and host paths here produce *identical* values (shared
+counter-based mixer), so the dual-engine compare harness still applies.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import (
+    Expression, Scalar, expand_scalar, expand_scalar_host, make_column,
+    make_host_column)
+
+
+@dataclasses.dataclass
+class EvalContext:
+    """Per-batch task context visible to contextual expressions.
+
+    ``partition_id``/``row_base`` may be python ints (host path) or traced
+    jnp scalars (device path under jit). ``row_base`` counts rows of the
+    partition that came before this batch.
+    """
+
+    partition_id: Any = 0
+    row_base: Any = 0
+    input_file: Optional[str] = None
+
+
+_EVAL_CTX: contextvars.ContextVar[Optional[EvalContext]] = \
+    contextvars.ContextVar("spark_rapids_tpu_eval_ctx", default=None)
+
+
+@contextlib.contextmanager
+def eval_context(ctx: EvalContext):
+    token = _EVAL_CTX.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _EVAL_CTX.reset(token)
+
+
+def current_eval_context() -> EvalContext:
+    ctx = _EVAL_CTX.get()
+    return ctx if ctx is not None else EvalContext()
+
+
+class ContextualExpression(Expression):
+    """Marker base: evaluation reads the EvalContext."""
+
+
+def needs_eval_context(exprs) -> bool:
+    """True when any expression tree contains a contextual node."""
+    def rec(e: Expression) -> bool:
+        if isinstance(e, ContextualExpression):
+            return True
+        return any(rec(c) for c in e.children)
+    return any(rec(e) for e in exprs)
+
+
+class SparkPartitionID(ContextualExpression):
+    """spark_partition_id() — ref GpuSparkPartitionID.scala:58."""
+
+    def data_type(self) -> DataType:
+        return dt.INT32
+
+    def eval(self, batch):
+        ctx = current_eval_context()
+        mask = batch.row_mask()
+        pid = jnp.asarray(ctx.partition_id, jnp.int32)
+        return make_column(dt.INT32, jnp.where(mask, pid, 0), mask)
+
+    def eval_host(self, batch):
+        ctx = current_eval_context()
+        n = batch.num_rows
+        return make_host_column(
+            dt.INT32, np.full(n, int(ctx.partition_id), np.int32),
+            np.ones(n, np.bool_))
+
+    def pretty(self) -> str:
+        return "spark_partition_id()"
+
+
+class MonotonicallyIncreasingID(ContextualExpression):
+    """monotonically_increasing_id(): (partition_id << 33) + row index
+    within the partition — ref GpuMonotonicallyIncreasingID.scala:75
+    (Spark's exact layout: upper 31 bits partition, lower 33 row)."""
+
+    def data_type(self) -> DataType:
+        return dt.INT64
+
+    def eval(self, batch):
+        ctx = current_eval_context()
+        mask = batch.row_mask()
+        pid = jnp.asarray(ctx.partition_id, jnp.int64)
+        base = jnp.asarray(ctx.row_base, jnp.int64)
+        idx = base + jnp.cumsum(mask.astype(jnp.int64)) - 1
+        val = (pid * (1 << 33)) + jnp.maximum(idx, 0)
+        return make_column(dt.INT64, jnp.where(mask, val, 0), mask)
+
+    def eval_host(self, batch):
+        ctx = current_eval_context()
+        n = batch.num_rows
+        idx = int(ctx.row_base) + np.arange(n, dtype=np.int64)
+        val = (np.int64(int(ctx.partition_id)) << np.int64(33)) + idx
+        return make_host_column(dt.INT64, val, np.ones(n, np.bool_))
+
+    def pretty(self) -> str:
+        return "monotonically_increasing_id()"
+
+
+# -- counter-based uniform RNG (identical jnp/numpy results) ----------------
+
+_GOLDEN = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+
+def _splitmix64(xp, x):
+    """SplitMix64 finalizer over uint64 (wrapping arithmetic; no bitcasts,
+    TPU x64-emulation safe)."""
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(_MIX1)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(_MIX2)
+    return x ^ (x >> np.uint64(31))
+
+
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _premix_seed(seed: int) -> int:
+    """SplitMix64 over python ints: decorrelates seeds BEFORE they are
+    combined with the row counter (a raw ``seed*GOLDEN + idx*GOLDEN``
+    counter would make seed s+1's stream a one-row shift of seed s's)."""
+    x = (seed * _GOLDEN) & _U64
+    x = ((x ^ (x >> 30)) * _MIX1) & _U64
+    x = ((x ^ (x >> 27)) * _MIX2) & _U64
+    return x ^ (x >> 31)
+
+
+def _uniform(xp, seed: int, pid, idx):
+    """uint64 counter -> float64 in [0, 1). idx is the absolute row index
+    within the partition; identical streams on device and host (uint64
+    wraparound is the point — numpy overflow warnings suppressed)."""
+    def impl():
+        ctr = (xp.asarray(np.uint64(_premix_seed(seed)))
+               + pid.astype(np.uint64) * np.uint64(_MIX1)
+               + idx.astype(np.uint64) * np.uint64(_GOLDEN))
+        bits = _splitmix64(xp, ctr) >> np.uint64(11)   # top 53 bits
+        return bits.astype(np.float64) * np.float64(2.0 ** -53)
+    if xp is np:
+        with np.errstate(over="ignore"):
+            return impl()
+    return impl()
+
+
+class Rand(ContextualExpression):
+    """rand(seed) — uniform [0,1) double, seeded per (seed, partition),
+    stable per absolute row index. Ref GpuRandomExpressions.scala:75 (same
+    incompat stance: distribution-equal, not sequence-equal, to Spark)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def eval(self, batch):
+        ctx = current_eval_context()
+        mask = batch.row_mask()
+        pid = jnp.asarray(ctx.partition_id, jnp.int64)
+        base = jnp.asarray(ctx.row_base, jnp.int64)
+        idx = base + jnp.arange(batch.capacity, dtype=jnp.int64)
+        u = _uniform(jnp, self.seed, pid, idx)
+        return make_column(dt.FLOAT64, jnp.where(mask, u, 0.0), mask)
+
+    def eval_host(self, batch):
+        ctx = current_eval_context()
+        n = batch.num_rows
+        pid = np.int64(int(ctx.partition_id))
+        idx = np.int64(int(ctx.row_base)) + np.arange(n, dtype=np.int64)
+        u = _uniform(np, self.seed, pid, idx)
+        return make_host_column(dt.FLOAT64, u, np.ones(n, np.bool_))
+
+    def pretty(self) -> str:
+        return f"rand({self.seed})"
+
+
+class InputFileName(ContextualExpression):
+    """input_file_name() — ref GpuInputFileBlock.scala. The scan publishes
+    the current file path into the ExecContext as it yields batches; the
+    value is a per-batch host string, so this node is not jittable (the
+    evaluating operator runs the projection eagerly — an expression-level
+    CPU-decision island, like the reference's disableCoalesceUntilInput
+    fence, GpuExpressions.scala:64-74)."""
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    @property
+    def self_jittable(self) -> bool:
+        return False
+
+    def _scalar(self) -> Scalar:
+        ctx = current_eval_context()
+        return Scalar(dt.STRING, ctx.input_file or "")
+
+    def eval(self, batch):
+        return self._scalar()
+
+    def eval_host(self, batch):
+        return self._scalar()
+
+    def pretty(self) -> str:
+        return "input_file_name()"

@@ -100,7 +100,8 @@ class FileScanExec(LeafExec):
 
     def __init__(self, fmt: str, paths: Sequence[str], schema: Schema,
                  options: Optional[Dict] = None,
-                 num_partitions: Optional[int] = None):
+                 num_partitions: Optional[int] = None,
+                 force_perfile: bool = False):
         super().__init__()
         self.fmt = fmt
         self.paths = list(paths)
@@ -108,6 +109,8 @@ class FileScanExec(LeafExec):
         self.options = dict(options or {})
         self._columns = [n for n, _ in self._schema]
         self._parts = num_partitions or min(len(self.paths), 8) or 1
+        # input_file_name() in the plan: batches must not span files.
+        self.force_perfile = force_perfile
 
     @property
     def schema(self) -> Schema:
@@ -125,6 +128,8 @@ class FileScanExec(LeafExec):
                 if i % self._parts == partition]
 
     def _reader_type(self, ctx) -> str:
+        if self.force_perfile:
+            return "PERFILE"
         rt = str(ctx.conf.get(C.PARQUET_READER_TYPE)).upper()
         if rt == "AUTO":
             return "MULTITHREADED"
@@ -137,6 +142,7 @@ class FileScanExec(LeafExec):
     def execute_host(self, ctx, partition):
         rows = self._batch_rows(ctx)
         for path in self._files_of(partition):
+            ctx.cache[f"input_file_host:{partition}"] = path
             yield from _read_file_batches(self.fmt, path, self.options,
                                           rows, self._columns)
 
@@ -147,12 +153,16 @@ class FileScanExec(LeafExec):
         rows = self._batch_rows(ctx)
         files = self._files_of(partition)
         if rt == "MULTITHREADED":
-            yield from self._device_multithreaded(ctx, m, files, rows)
+            yield from self._device_multithreaded(ctx, m, files, rows,
+                                                  partition)
             return
         if rt == "COALESCING":
             yield from self._device_coalescing(ctx, m, files, rows)
             return
         for path in files:   # PERFILE
+            # Publish the current file for input_file_name() downstream
+            # (GpuInputFileBlock analog; per-batch, pre-yield).
+            ctx.cache[f"input_file:{partition}"] = path
             for hb in _read_file_batches(self.fmt, path, self.options,
                                          rows, self._columns):
                 with timed(m, "bufferTime"):
@@ -160,7 +170,7 @@ class FileScanExec(LeafExec):
                 m.add("numOutputBatches", 1)
                 yield batch
 
-    def _device_multithreaded(self, ctx, m, files, rows):
+    def _device_multithreaded(self, ctx, m, files, rows, partition):
         """Background host decode overlapped with device consumption
         (MultiFileCloudParquetPartitionReader's thread-pool overlap)."""
         nthreads = int(ctx.conf.get(
@@ -171,7 +181,8 @@ class FileScanExec(LeafExec):
                 pool.submit(lambda p=p: list(_read_file_batches(
                     self.fmt, p, self.options, rows, self._columns)))
                 for p in files]
-            for fut in futures:
+            for path, fut in zip(files, futures):
+                ctx.cache[f"input_file:{partition}"] = path
                 for hb in fut.result():
                     with timed(m, "bufferTime"):
                         batch = host_to_device(hb)
@@ -202,7 +213,9 @@ class FileScanExec(LeafExec):
         return batch
 
 
-def make_scan_exec(file_scan, conf) -> FileScanExec:
+def make_scan_exec(file_scan, conf, force_perfile: bool = False
+                   ) -> FileScanExec:
     """Planner hook for L.FileScan nodes."""
     return FileScanExec(file_scan.fmt, file_scan.paths,
-                        file_scan.source_schema, file_scan.options)
+                        file_scan.source_schema, file_scan.options,
+                        force_perfile=force_perfile)

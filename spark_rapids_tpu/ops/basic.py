@@ -21,7 +21,59 @@ from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.exprs.base import (
     Expression, as_device_column, as_host_column, eval_exprs,
     eval_exprs_host)
+from spark_rapids_tpu.exprs.nondeterministic import (
+    EvalContext, eval_context, needs_eval_context)
 from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+
+
+def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
+                            kernel, ctx: ExecContext, partition: int):
+    """Drive ``kernel(batch)`` over the child's batches with an EvalContext
+    (partition id / row base / input file) attached around each call.
+
+    When every expression is jittable the compiled program takes the
+    partition id and row base as *traced* int scalars — one compilation
+    serves all partitions; the row base is carried as a device scalar with
+    no host sync. Non-jittable trees run eagerly so per-batch host values
+    (input_file_name) can be read at eval time.
+    """
+    m = ctx.metrics_for(op)
+    jittable = all(e.jittable for e in exprs)
+    if jittable:
+        if getattr(op, "_ctx_jit", None) is None:
+            def kfn(b, pid, base):
+                with eval_context(EvalContext(pid, base)):
+                    out = kernel(b)
+                return out, base + b.num_rows.astype(jnp.int64)
+            op._ctx_jit = jax.jit(kfn)
+        pid = jnp.asarray(partition, jnp.int32)
+        base = jnp.asarray(0, jnp.int64)
+        for batch in op.children[0].execute_device(ctx, partition):
+            with timed(m):
+                out, base = op._ctx_jit(batch, pid, base)
+            m.add("numOutputBatches", 1)
+            yield out
+    else:
+        base = 0
+        for batch in op.children[0].execute_device(ctx, partition):
+            ec = EvalContext(partition, base,
+                             ctx.cache.get(f"input_file:{partition}"))
+            with timed(m), eval_context(ec):
+                out = kernel(batch)
+            base = base + batch.num_rows.astype(jnp.int64)
+            m.add("numOutputBatches", 1)
+            yield out
+
+
+def _contextual_host_loop(op: Exec, kernel, ctx: ExecContext,
+                          partition: int):
+    base = 0
+    for hb in op.children[0].execute_host(ctx, partition):
+        ec = EvalContext(partition, base,
+                         ctx.cache.get(f"input_file_host:{partition}"))
+        with eval_context(ec):
+            yield kernel(hb)
+        base += hb.num_rows
 
 
 class ProjectExec(Exec):
@@ -41,6 +93,11 @@ class ProjectExec(Exec):
                      for n, e in zip(self.names, self.exprs))
 
     def execute_device(self, ctx, partition):
+        if needs_eval_context(self.exprs):
+            yield from _contextual_device_loop(
+                self, self.exprs, lambda b: eval_exprs(self.exprs, b),
+                ctx, partition)
+            return
         m = ctx.metrics_for(self)
         if self._jit is None and all(e.jittable for e in self.exprs):
             self._jit = jax.jit(lambda b: eval_exprs(self.exprs, b))
@@ -52,6 +109,11 @@ class ProjectExec(Exec):
             yield out
 
     def execute_host(self, ctx, partition):
+        if needs_eval_context(self.exprs):
+            yield from _contextual_host_loop(
+                self, lambda hb: eval_exprs_host(self.exprs, hb, self.names),
+                ctx, partition)
+            return
         for hb in self.children[0].execute_host(ctx, partition):
             yield eval_exprs_host(self.exprs, hb, self.names)
 
@@ -74,7 +136,18 @@ class FilterExec(Exec):
         keep = cond.data & cond.validity
         return batch.compact(keep)
 
+    def _host_kernel(self, hb: HostBatch) -> HostBatch:
+        cond = as_host_column(self.condition.eval_host(hb), hb)
+        keep = cond.data & cond.validity
+        cols = [HostColumn(c.dtype, c.data[keep], c.validity[keep])
+                for c in hb.columns]
+        return HostBatch(hb.names, cols)
+
     def execute_device(self, ctx, partition):
+        if needs_eval_context([self.condition]):
+            yield from _contextual_device_loop(
+                self, [self.condition], self._kernel, ctx, partition)
+            return
         m = ctx.metrics_for(self)
         if self._jit is None and self.condition.jittable:
             self._jit = jax.jit(self._kernel)
@@ -86,14 +159,12 @@ class FilterExec(Exec):
             yield out
 
     def execute_host(self, ctx, partition):
+        if needs_eval_context([self.condition]):
+            yield from _contextual_host_loop(
+                self, self._host_kernel, ctx, partition)
+            return
         for hb in self.children[0].execute_host(ctx, partition):
-            cond = as_host_column(self.condition.eval_host(hb), hb)
-            keep = cond.data & cond.validity
-            cols = []
-            for c in hb.columns:
-                cols.append(HostColumn(c.dtype, c.data[keep],
-                                       c.validity[keep]))
-            yield HostBatch(hb.names, cols)
+            yield self._host_kernel(hb)
 
 
 class UnionExec(Exec):

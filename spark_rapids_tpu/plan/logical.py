@@ -224,6 +224,23 @@ def murmur3_hash(*cs):
     return Column(("hash", tuple(_as_col(c) for c in cs)))
 
 
+def rand(seed: int = 0) -> Column:
+    """Uniform [0,1) per row (nondeterministic; seeded per partition)."""
+    return Column(("rand", int(seed)))
+
+
+def spark_partition_id() -> Column:
+    return Column(("spark_partition_id",))
+
+
+def monotonically_increasing_id() -> Column:
+    return Column(("monotonically_increasing_id",))
+
+
+def input_file_name() -> Column:
+    return Column(("input_file_name",))
+
+
 # Aggregate builders.
 def agg_sum(c) -> Column:
     return Column(("agg", "sum", _as_col(c)))
@@ -347,6 +364,14 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.Round(rec(node[1]), node[2])
     if kind == "hash":
         return E.Murmur3Hash([rec(x) for x in node[1]])
+    if kind == "rand":
+        return E.Rand(node[1])
+    if kind == "spark_partition_id":
+        return E.SparkPartitionID()
+    if kind == "monotonically_increasing_id":
+        return E.MonotonicallyIncreasingID()
+    if kind == "input_file_name":
+        return E.InputFileName()
     if kind == "sortorder":
         raise ResolutionError("sort order only valid in orderBy")
     raise ResolutionError(f"cannot resolve expression kind {kind!r}")

@@ -44,7 +44,8 @@ from spark_rapids_tpu.parallel import (
     HashPartitioning, RangePartitioning, RoundRobinPartitioning,
     ShuffleExchangeExec, SinglePartitioning)
 from spark_rapids_tpu.plan import logical as L
-from spark_rapids_tpu.plan.logical import Column, LogicalPlan, resolve
+from spark_rapids_tpu.plan.logical import (
+    Column, LogicalPlan, ResolutionError, resolve)
 
 
 # ---------------------------------------------------------------------------
@@ -59,6 +60,62 @@ _INCOMPAT_EXPRS = {
 
 # Kinds that execute on the host even inside the device plan (regex etc.).
 _HOST_ROUNDTRIP_EXPRS = {"regexp_replace"}
+
+# Kinds whose value depends on the task context rather than column inputs.
+_CONTEXTUAL_EXPRS = {
+    "rand": "nondeterministic (distribution-equal to Spark, not "
+            "sequence-equal)",
+    "input_file_name": "reads the per-batch host file path; disables "
+                       "projection jit",
+}
+
+# All task-context kinds; only Project/Filter thread an EvalContext, so
+# anywhere else these would silently evaluate with pid=0/row_base=0
+# (Spark's CheckAnalysis draws the same line for nondeterministic exprs).
+_CONTEXTUAL_KINDS = {"rand", "spark_partition_id",
+                     "monotonically_increasing_id", "input_file_name"}
+
+
+def _column_kinds(c: Column, out: set):
+    out.add(c.node[0])
+    for x in c.node[1:]:
+        if isinstance(x, Column):
+            _column_kinds(x, out)
+        elif isinstance(x, tuple):
+            for y in x:
+                if isinstance(y, Column):
+                    _column_kinds(y, out)
+                elif isinstance(y, tuple):
+                    for z in y:
+                        if isinstance(z, Column):
+                            _column_kinds(z, out)
+    return out
+
+
+def _uses_input_file(plan: LogicalPlan) -> bool:
+    """True when any Project/Filter column references input_file_name():
+    scans must then stay per-file (the reference's disableCoalesceUntilInput
+    fence, GpuExpressions.scala:64-74) so the published path is exact."""
+    cols: List[Column] = []
+    if isinstance(plan, L.LogicalProject):
+        cols = [c for _, c in plan.projections]
+    elif isinstance(plan, L.LogicalFilter):
+        cols = [plan.condition]
+    for c in cols:
+        if "input_file_name" in _column_kinds(c, set()):
+            return True
+    return any(_uses_input_file(ch) for ch in plan.children)
+
+
+def _forbid_contextual(c: Column, where: str):
+    """Analysis-time guard: contextual expressions are only valid where the
+    evaluating operator threads an EvalContext (select/filter)."""
+    bad = _column_kinds(c, set()) & _CONTEXTUAL_KINDS
+    if bad:
+        raise ResolutionError(
+            f"nondeterministic/task-context expression(s) {sorted(bad)} are "
+            f"only supported in select/filter/with_column, not in {where} "
+            "(evaluate them into a column first)")
 
 
 def _expr_conf_key(kind: str) -> str:
@@ -82,6 +139,8 @@ def tag_column(c: Column, conf: C.TpuConf, reasons: List[str],
             "enable spark.rapids.sql.incompatibleOps.enabled to allow")
     if kind in _HOST_ROUNDTRIP_EXPRS:
         notes.append(f"expression {kind} runs via a host roundtrip")
+    if kind in _CONTEXTUAL_EXPRS:
+        notes.append(f"expression {kind}: {_CONTEXTUAL_EXPRS[kind]}")
     for x in c.node[1:]:
         if isinstance(x, Column):
             tag_column(x, conf, reasons, notes)
@@ -156,8 +215,10 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
             tag_column(c, conf, reasons, notes)
     elif isinstance(plan, L.LogicalAggregate):
         for _, c in plan.group_by:
+            _forbid_contextual(c, "group_by")
             tag_column(c, conf, reasons, notes)
         for _, c in plan.aggregates:
+            _forbid_contextual(c, "aggregates")
             ac = _unalias(c)
             inner = ac.node[2] if ac.node[0] == "agg" else None
             if inner is not None:
@@ -167,12 +228,19 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
     elif isinstance(plan, L.LogicalSort):
         for o in plan.orders:
             inner = o.node[1] if o.node[0] == "sortorder" else o
+            _forbid_contextual(inner, "order_by")
             tag_column(inner, conf, reasons, notes)
     elif isinstance(plan, L.LogicalJoin):
         for k in plan.left_keys + plan.right_keys:
+            _forbid_contextual(k, "join keys")
             tag_column(k, conf, reasons, notes)
         if plan.condition is not None:
+            _forbid_contextual(plan.condition, "join condition")
             tag_column(plan.condition, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalRepartition):
+        for k in (plan.keys or []):
+            _forbid_contextual(k, "repartition keys")
+            tag_column(k, conf, reasons, notes)
     return meta
 
 
@@ -264,6 +332,7 @@ class Planner:
     def plan(self, logical: LogicalPlan) -> PhysicalPlan:
         from spark_rapids_tpu.plan.pruning import prune_columns
         logical = prune_columns(logical)
+        self._force_perfile = _uses_input_file(logical)
         meta = wrap_and_tag(logical, self.conf)
         if self.conf.explain in ("ALL", "NOT_ON_GPU"):
             print("\n".join(meta.explain_lines(
@@ -317,10 +386,15 @@ class Planner:
             return InMemorySourceExec(plan.schema, plan.partitions), want_dev
         if isinstance(plan, L.FileScan):
             from spark_rapids_tpu.io import make_scan_exec
-            return make_scan_exec(plan, self.conf), want_dev
+            return make_scan_exec(
+                plan, self.conf,
+                force_perfile=getattr(self, "_force_perfile", False)
+            ), want_dev
         if isinstance(plan, L.LogicalRange):
             return RangeExec(plan.start, plan.end, plan.step,
-                             plan.num_partitions), want_dev
+                             plan.num_partitions,
+                             batch_rows=int(self.conf.get(
+                                 C.BATCH_SIZE_ROWS))), want_dev
         if isinstance(plan, L.LogicalFilter):
             child, cdev = kids[0]
             cond = resolve(plan.condition, plan.child.schema)
