@@ -7,20 +7,31 @@ Reader strategies mirror RapidsConf's
 - MULTITHREADED: a host thread pool prefetches+decodes files in the
   background while the device consumes earlier ones — the
   MultiFileCloudParquetPartitionReader overlap (GpuParquetScan.scala:1144).
-- COALESCING: decode several files and concatenate their rows into fewer,
+- COALESCING: decode several units and concatenate their rows into fewer,
   larger device batches (MultiFileParquetPartitionReader:823's
-  stitch-row-groups idea at the arrow level).
+  stitch-row-groups idea at the arrow level — small row groups from MANY
+  files merge into one upload).
 - AUTO: MULTITHREADED (the cloud default heuristic).
 
-Partitioning: files are distributed round-robin over N partitions
-(one Spark task per file-chunk analog). Row-group-level splits are handled
-inside pyarrow's batch iteration.
+Partitioning is at **scan-unit** granularity: a unit is one parquet row
+group / one ORC stripe / one CSV file (the footer parse that enumerates
+them is CPU-side, exactly the reference's split — GpuParquetScan.scala:823
+``populateCurrentBlockChunk``). Units are dealt round-robin over N
+partitions, so one big parquet file parallelizes across partitions
+instead of becoming a single giant host decode.
+
+Predicate pushdown: pushed conjuncts (plan/pruning.pushdown_filters) are
+checked against per-row-group min/max/null statistics; units whose stats
+prove no row can match are skipped without reading data bytes
+(GpuParquetScan filter pushdown / OrcFilters.scala analog).
 """
 
 from __future__ import annotations
 
 import concurrent.futures
-from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+import dataclasses
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -68,27 +79,120 @@ def _csv_read_options(options: Dict, sample: bool = False):
     return kwargs
 
 
-def _read_file_batches(fmt: str, path: str, options: Dict,
+@dataclasses.dataclass(frozen=True)
+class ScanUnit:
+    """One independently-readable slice of a file: a parquet row group,
+    an ORC stripe, or a whole CSV file (``index is None``)."""
+
+    path: str
+    index: Optional[int]        # row group / stripe ordinal
+    rows: int                   # 0 = unknown (csv)
+
+
+# (path, mtime) -> parquet FileMetaData; footer parses are cheap but
+# repeated across planning + N partitions, so memoize.
+_PQ_META_CACHE: Dict[Tuple[str, float], Any] = {}
+
+
+def _parquet_metadata(path: str):
+    key = (path, os.path.getmtime(path))
+    md = _PQ_META_CACHE.get(key)
+    if md is None:
+        md = papq.ParquetFile(path).metadata
+        _PQ_META_CACHE[key] = md
+    return md
+
+
+def enumerate_units(fmt: str, paths: Sequence[str]) -> List[ScanUnit]:
+    """CPU-side footer/tail parse producing the scan's split units
+    (GpuParquetScan.scala:823 block enumeration analog)."""
+    units: List[ScanUnit] = []
+    for path in paths:
+        if fmt == "parquet":
+            md = _parquet_metadata(path)
+            for rg in range(md.num_row_groups):
+                units.append(ScanUnit(path, rg, md.row_group(rg).num_rows))
+        elif fmt == "orc":
+            f = paorc.ORCFile(path)
+            for si in range(f.nstripes):
+                units.append(ScanUnit(path, si, 0))
+        else:
+            units.append(ScanUnit(path, None, 0))
+    return units
+
+
+def _unit_survives(fmt: str, unit: ScanUnit,
+                   predicates: Sequence[Tuple[str, str, Any]]) -> bool:
+    """False when row-group statistics prove no row in the unit can
+    satisfy ALL pushed conjuncts (conservative: missing/odd stats keep
+    the unit). SQL null semantics make this safe — a comparison is never
+    true for NULL, so bounds over non-null values suffice."""
+    if fmt != "parquet" or not predicates:
+        return True
+    rg = _parquet_metadata(unit.path).row_group(unit.index)
+    stats_by_name = {}
+    for ci in range(rg.num_columns):
+        col = rg.column(ci)
+        stats_by_name[col.path_in_schema] = col.statistics
+    for name, op, value in predicates:
+        st = stats_by_name.get(name)
+        if st is None:
+            continue
+        try:
+            if op == "isnotnull":
+                if st.null_count is not None and \
+                        st.null_count == rg.num_rows:
+                    return False
+                continue
+            if not st.has_min_max:
+                # All-null pages carry no min/max: a comparison predicate
+                # can never be true then.
+                if st.null_count is not None and \
+                        st.null_count == rg.num_rows:
+                    return False
+                continue
+            mn, mx = st.min, st.max
+            v = value.decode() if isinstance(value, bytes) else value
+            mn = mn.decode() if isinstance(mn, bytes) else mn
+            mx = mx.decode() if isinstance(mx, bytes) else mx
+            if op == "eq" and (v < mn or v > mx):
+                return False
+            if op == "lt" and mn >= v:
+                return False
+            if op == "le" and mn > v:
+                return False
+            if op == "gt" and mx <= v:
+                return False
+            if op == "ge" and mx < v:
+                return False
+        except TypeError:
+            continue    # incomparable stat/value types: keep the unit
+    return True
+
+
+def _read_unit_batches(fmt: str, unit: ScanUnit, options: Dict,
                        batch_rows: int,
                        columns: Optional[List[str]] = None
                        ) -> Iterator[HostBatch]:
-    """Decode one file; ``columns`` restricts the read to a pruned schema
-    (GpuParquetScan readDataSchema analog — unread columns are never
-    decoded)."""
+    """Decode one scan unit; ``columns`` restricts the read to a pruned
+    schema (GpuParquetScan readDataSchema analog — unread columns are
+    never decoded)."""
     if fmt == "parquet":
-        pf = papq.ParquetFile(path)
-        for rb in pf.iter_batches(batch_size=batch_rows, columns=columns):
+        pf = papq.ParquetFile(unit.path)
+        for rb in pf.iter_batches(batch_size=batch_rows,
+                                  row_groups=[unit.index],
+                                  columns=columns):
             yield arrow_to_host_batch(rb)
     elif fmt == "orc":
-        f = paorc.ORCFile(path)
-        for si in range(f.nstripes):
-            yield arrow_to_host_batch(f.read_stripe(si, columns=columns))
+        f = paorc.ORCFile(unit.path)
+        yield arrow_to_host_batch(
+            f.read_stripe(unit.index, columns=columns))
     elif fmt == "csv":
         kwargs = _csv_read_options(options)
         if columns:
             kwargs["convert_options"] = pacsv.ConvertOptions(
                 include_columns=list(columns))
-        tbl = pacsv.read_csv(path, **kwargs)
+        tbl = pacsv.read_csv(unit.path, **kwargs)
         for rb in tbl.to_batches(max_chunksize=batch_rows):
             yield arrow_to_host_batch(rb)
     else:
@@ -96,19 +200,24 @@ def _read_file_batches(fmt: str, path: str, options: Dict,
 
 
 class FileScanExec(LeafExec):
-    """Leaf scan over N files in a format, with reader strategies."""
+    """Leaf scan over N files in a format, with reader strategies.
+    Splits at scan-unit (row-group/stripe) granularity and applies pushed
+    predicates as row-group stats skips."""
 
     def __init__(self, fmt: str, paths: Sequence[str], schema: Schema,
                  options: Optional[Dict] = None,
                  num_partitions: Optional[int] = None,
-                 force_perfile: bool = False):
+                 force_perfile: bool = False,
+                 predicates: Sequence[Tuple[str, str, Any]] = ()):
         super().__init__()
         self.fmt = fmt
         self.paths = list(paths)
         self._schema = tuple(schema)
         self.options = dict(options or {})
         self._columns = [n for n, _ in self._schema]
-        self._parts = num_partitions or min(len(self.paths), 8) or 1
+        self.predicates = tuple(predicates)
+        self._units = enumerate_units(fmt, self.paths)
+        self._parts = num_partitions or min(len(self._units), 8) or 1
         # input_file_name() in the plan: batches must not span files.
         self.force_perfile = force_perfile
 
@@ -123,9 +232,17 @@ class FileScanExec(LeafExec):
     def num_partitions(self, ctx) -> int:
         return self._parts
 
-    def _files_of(self, partition: int) -> List[str]:
-        return [p for i, p in enumerate(self.paths)
+    def _units_of(self, partition: int, m=None) -> List[ScanUnit]:
+        """This partition's units, minus stats-skipped ones."""
+        mine = [u for i, u in enumerate(self._units)
                 if i % self._parts == partition]
+        if not self.predicates:
+            return mine
+        kept = [u for u in mine
+                if _unit_survives(self.fmt, u, self.predicates)]
+        if m is not None and len(kept) < len(mine):
+            m.add("numSkippedRowGroups", len(mine) - len(kept))
+        return kept
 
     def _reader_type(self, ctx) -> str:
         if self.force_perfile:

@@ -428,6 +428,11 @@ class FileScan(LogicalPlan):
     paths: list
     source_schema: Schema
     options: dict
+    # Pushed-down filter conjuncts: (column_name, op, value) with op in
+    # eq/lt/le/gt/ge/isnotnull — evaluated against parquet row-group
+    # min/max stats to skip whole row groups (GpuParquetScan predicate
+    # pushdown analog; the full filter still runs above the scan).
+    predicates: tuple = ()
     children = ()
 
     @property

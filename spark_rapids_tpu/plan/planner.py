@@ -330,8 +330,9 @@ class Planner:
 
     # -- public --------------------------------------------------------------
     def plan(self, logical: LogicalPlan) -> PhysicalPlan:
-        from spark_rapids_tpu.plan.pruning import prune_columns
-        logical = prune_columns(logical)
+        from spark_rapids_tpu.plan.pruning import (
+            prune_columns, pushdown_filters)
+        logical = pushdown_filters(prune_columns(logical))
         self._force_perfile = _uses_input_file(logical)
         meta = wrap_and_tag(logical, self.conf)
         if self.conf.explain in ("ALL", "NOT_ON_GPU"):

@@ -47,6 +47,71 @@ def prune_columns(plan: LogicalPlan) -> LogicalPlan:
     return _prune(plan, None)
 
 
+# ---------------------------------------------------------------------------
+# Filter pushdown: attach simple conjuncts to file scans for row-group
+# stats skipping (GpuParquetScan.scala predicate pushdown / OrcFilters
+# analog). The filter node itself stays in the plan — pushed predicates
+# only *skip* row groups whose min/max stats prove no row can match.
+# ---------------------------------------------------------------------------
+
+_PUSH_OPS = {"eq": "eq", "lt": "lt", "le": "le", "gt": "gt", "ge": "ge"}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+
+
+def _conjuncts(c: Column, out: list):
+    if c.node[0] == "and":
+        _conjuncts(c.node[1], out)
+        _conjuncts(c.node[2], out)
+    else:
+        out.append(c)
+    return out
+
+
+def _as_predicate(c: Column):
+    """(name, op, value) for a supported conjunct, else None."""
+    node = c.node
+    kind = node[0]
+    if kind == "isnotnull" and node[1].node[0] == "ref":
+        return (node[1].node[1], "isnotnull", None)
+    if kind in _PUSH_OPS:
+        l, r = node[1], node[2]
+        if l.node[0] == "ref" and r.node[0] == "lit":
+            return (l.node[1], kind, r.node[1])
+        if l.node[0] == "lit" and r.node[0] == "ref":
+            return (r.node[1], _FLIP[kind], l.node[1])
+    return None
+
+
+def pushdown_filters(plan: LogicalPlan) -> LogicalPlan:
+    """Entry point: copy filter conjuncts onto scans they sit above."""
+    if isinstance(plan, L.LogicalFilter) and \
+            isinstance(plan.child, L.FileScan):
+        preds = []
+        for cj in _conjuncts(plan.condition, []):
+            p = _as_predicate(cj)
+            if p is not None:
+                preds.append(p)
+        if preds:
+            scan = plan.child
+            new_scan = L.FileScan(scan.fmt, scan.paths, scan.source_schema,
+                                  scan.options,
+                                  tuple(scan.predicates) + tuple(preds))
+            return L.LogicalFilter(new_scan, plan.condition)
+        return plan
+    rebuilt = [pushdown_filters(c) for c in plan.children]
+    if all(a is b for a, b in zip(rebuilt, plan.children)):
+        return plan
+    return _with_children(plan, rebuilt)
+
+
+def _with_children(plan: LogicalPlan, kids) -> LogicalPlan:
+    """Shallow-copy a logical node with new children."""
+    import copy
+    cp = copy.copy(plan)
+    cp.children = tuple(kids)
+    return cp
+
+
 def _prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
     # required == None means "every column of this subtree's schema".
     if isinstance(plan, L.FileScan):
@@ -55,7 +120,8 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
         kept = tuple(f for f in plan.source_schema if f[0] in required)
         if not kept or len(kept) == len(plan.source_schema):
             return plan
-        return L.FileScan(plan.fmt, plan.paths, kept, plan.options)
+        return L.FileScan(plan.fmt, plan.paths, kept, plan.options,
+                          plan.predicates)
     if isinstance(plan, (L.InMemoryScan, L.LogicalRange)):
         return plan
     if isinstance(plan, L.LogicalFilter):
