@@ -250,17 +250,33 @@ def device_to_host(batch: DeviceBatch,
     """Download a device batch, trimming padding rows.
 
     Ref: GpuColumnarToRowExec.scala — the single place results leave HBM.
+
+    All buffers (row count + every column's data/validity/lengths) are
+    fetched in ONE ``jax.device_get`` so the transfers run concurrently:
+    on a remote/tunneled device each sequential D2H is a full network
+    round trip (~200ms), so per-buffer ``np.asarray`` loops cost
+    O(columns) round trips while this costs one.
     """
-    n = int(batch.num_rows)
+    import jax
+    leaves: List = [batch.num_rows]
+    for c in batch.columns:
+        leaves.append(c.data)
+        leaves.append(c.validity)
+        if c.dtype.is_string:
+            leaves.append(c.lengths)
+    fetched = jax.device_get(leaves)
+    n = int(fetched[0])
+    it = iter(fetched[1:])
     cols = []
     for c in batch.columns:
-        validity = np.asarray(c.validity)[:n]
+        data_h = next(it)
+        validity = np.asarray(next(it))[:n]
         if c.dtype.is_string:
-            cols.append(matrix_to_strings(np.asarray(c.data)[:n],
-                                          np.asarray(c.lengths)[:n],
-                                          validity))
+            lengths = np.asarray(next(it))[:n]
+            cols.append(matrix_to_strings(np.asarray(data_h)[:n],
+                                          lengths, validity))
         else:
-            data = np.asarray(c.data)[:n].copy()
+            data = np.asarray(data_h)[:n].copy()
             data[~validity] = np.zeros(1, c.dtype.np_dtype)
             cols.append(HostColumn(c.dtype, data, validity))
     if names is None:

@@ -89,16 +89,24 @@ class ScanUnit:
     rows: int                   # 0 = unknown (csv)
 
 
-# (path, mtime) -> parquet FileMetaData; footer parses are cheap but
-# repeated across planning + N partitions, so memoize.
-_PQ_META_CACHE: Dict[Tuple[str, float], Any] = {}
+# (path, mtime, size) -> parquet FileMetaData; footer parses are cheap but
+# repeated across planning + N partitions, so memoize. Bounded: inserting a
+# new entry evicts stale entries for the same path (rewritten files), and
+# the whole cache is FIFO-capped so long sessions don't leak FileMetaData.
+_PQ_META_CACHE: Dict[Tuple[str, float, int], Any] = {}
+_PQ_META_CACHE_MAX = 1024
 
 
 def _parquet_metadata(path: str):
-    key = (path, os.path.getmtime(path))
+    st = os.stat(path)
+    key = (path, st.st_mtime, st.st_size)
     md = _PQ_META_CACHE.get(key)
     if md is None:
         md = papq.ParquetFile(path).metadata
+        for stale in [k for k in _PQ_META_CACHE if k[0] == path]:
+            del _PQ_META_CACHE[stale]
+        while len(_PQ_META_CACHE) >= _PQ_META_CACHE_MAX:
+            _PQ_META_CACHE.pop(next(iter(_PQ_META_CACHE)))
         _PQ_META_CACHE[key] = md
     return md
 
@@ -255,12 +263,22 @@ class FileScanExec(LeafExec):
     def _batch_rows(self, ctx) -> int:
         return int(ctx.conf.get(C.MAX_READER_BATCH_SIZE_ROWS))
 
+    def _publish_input_file(self, ctx, partition: int, path: str,
+                            host: bool = False) -> None:
+        """Publish the current file for input_file_name() downstream
+        (GpuInputFileBlock analog; per-unit, pre-yield). Keys are scoped to
+        this scan instance so two scans sharing a partition (join of two
+        reads) never clobber each other; the consumer resolves the key via
+        its unique descendant scan (ops/basic.py)."""
+        prefix = "input_file_host" if host else "input_file"
+        ctx.cache[f"{prefix}:{id(self)}:{partition}"] = path
+
     # -- host engine ---------------------------------------------------------
     def execute_host(self, ctx, partition):
         rows = self._batch_rows(ctx)
-        for path in self._files_of(partition):
-            ctx.cache[f"input_file_host:{partition}"] = path
-            yield from _read_file_batches(self.fmt, path, self.options,
+        for unit in self._units_of(partition):
+            self._publish_input_file(ctx, partition, unit.path, host=True)
+            yield from _read_unit_batches(self.fmt, unit, self.options,
                                           rows, self._columns)
 
     # -- device engine -------------------------------------------------------
@@ -268,50 +286,68 @@ class FileScanExec(LeafExec):
         m = ctx.metrics_for(self)
         rt = self._reader_type(ctx)
         rows = self._batch_rows(ctx)
-        files = self._files_of(partition)
+        units = self._units_of(partition, m)
         if rt == "MULTITHREADED":
-            yield from self._device_multithreaded(ctx, m, files, rows,
+            yield from self._device_multithreaded(ctx, m, units, rows,
                                                   partition)
             return
         if rt == "COALESCING":
-            yield from self._device_coalescing(ctx, m, files, rows)
+            yield from self._device_coalescing(ctx, m, units, rows)
             return
-        for path in files:   # PERFILE
-            # Publish the current file for input_file_name() downstream
-            # (GpuInputFileBlock analog; per-batch, pre-yield).
-            ctx.cache[f"input_file:{partition}"] = path
-            for hb in _read_file_batches(self.fmt, path, self.options,
+        for unit in units:   # PERFILE
+            self._publish_input_file(ctx, partition, unit.path)
+            for hb in _read_unit_batches(self.fmt, unit, self.options,
                                          rows, self._columns):
                 with timed(m, "bufferTime"):
                     batch = host_to_device(hb)
                 m.add("numOutputBatches", 1)
                 yield batch
 
-    def _device_multithreaded(self, ctx, m, files, rows, partition):
+    def _device_multithreaded(self, ctx, m, units, rows, partition):
         """Background host decode overlapped with device consumption
-        (MultiFileCloudParquetPartitionReader's thread-pool overlap)."""
+        (MultiFileCloudParquetPartitionReader's thread-pool overlap,
+        GpuParquetScan.scala:1144). Streaming: at most ``nthreads`` units
+        are in flight at once and each finished unit's batches are yielded
+        (uploaded) while later units keep decoding in the background —
+        never the old whole-partition ``list(...)`` buffering."""
         nthreads = int(ctx.conf.get(
             C.PARQUET_MULTITHREADED_READ_NUM_THREADS))
+        if not units:
+            return
+        window = min(nthreads, len(units))
+
+        def read_unit(u):
+            return list(_read_unit_batches(self.fmt, u, self.options,
+                                           rows, self._columns))
+
         with concurrent.futures.ThreadPoolExecutor(
-                max_workers=min(nthreads, max(len(files), 1))) as pool:
-            futures = [
-                pool.submit(lambda p=p: list(_read_file_batches(
-                    self.fmt, p, self.options, rows, self._columns)))
-                for p in files]
-            for path, fut in zip(files, futures):
-                ctx.cache[f"input_file:{partition}"] = path
-                for hb in fut.result():
+                max_workers=window) as pool:
+            inflight = []          # [(unit, future)] bounded by `window`
+            it = iter(units)
+            for u in it:
+                inflight.append((u, pool.submit(read_unit, u)))
+                if len(inflight) >= window:
+                    break
+            while inflight:
+                unit, fut = inflight.pop(0)
+                hbs = fut.result()
+                nxt = next(it, None)
+                if nxt is not None:
+                    inflight.append((nxt, pool.submit(read_unit, nxt)))
+                self._publish_input_file(ctx, partition, unit.path)
+                for hb in hbs:
                     with timed(m, "bufferTime"):
                         batch = host_to_device(hb)
                     m.add("numOutputBatches", 1)
                     yield batch
 
-    def _device_coalescing(self, ctx, m, files, rows):
-        """Concatenate small files' rows into fewer, larger uploads."""
+    def _device_coalescing(self, ctx, m, units, rows):
+        """Concatenate small units' rows into fewer, larger uploads
+        (MultiFileParquetPartitionReader:823 stitch idea)."""
         pending: List[HostBatch] = []
         pending_rows = 0
-        for path in files:
-            for hb in _read_file_batches(self.fmt, path, self.options,
+        for unit in units:
+            for hb in _read_unit_batches(self.fmt, unit, self.options,
                                          rows, self._columns):
                 pending.append(hb)
                 pending_rows += hb.num_rows
@@ -335,4 +371,5 @@ def make_scan_exec(file_scan, conf, force_perfile: bool = False
     """Planner hook for L.FileScan nodes."""
     return FileScanExec(file_scan.fmt, file_scan.paths,
                         file_scan.source_schema, file_scan.options,
-                        force_perfile=force_perfile)
+                        force_perfile=force_perfile,
+                        predicates=getattr(file_scan, "predicates", ()))

@@ -274,6 +274,9 @@ class SpillableBatch:
         self._catalog = catalog
         self._id = catalog.add_batch(batch, priority)
         self._closed = False
+        # Host-known row capacity (static shape) — lets consumers group
+        # handles by size without any device sync.
+        self.capacity = batch.capacity
 
     def get(self) -> DeviceBatch:
         return self._catalog.acquire_batch(self._id)

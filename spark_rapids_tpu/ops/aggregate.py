@@ -549,11 +549,20 @@ class HashAggregateExec(Exec):
         m = ctx.metrics_for(self)
         update, merge, finalize = self._jits()
 
+        from spark_rapids_tpu import config as C
         from spark_rapids_tpu.columnar.batch import (
             jit_concat_batches, shrink_to_capacity)
         acc: Optional[DeviceBatch] = None
         saw_input = False
         offset = 0
+        # Shrinking the accumulator to its true group-count bucket needs a
+        # device->host sync of the group count — on a remote/tunneled chip
+        # that is a full network round trip, so do it only when the
+        # accumulator's capacity has grown past a threshold (and once at
+        # the end) instead of per input batch. High-cardinality groupbys
+        # degrade gracefully: the threshold trips every batch and behavior
+        # matches the reference's per-batch re-merge (aggregate.scala:427).
+        shrink_at = 2 * int(ctx.conf.get(C.BATCH_SIZE_ROWS))
         for batch in self.children[0].execute_device(ctx, partition):
             saw_input = True
             with timed(m):
@@ -561,18 +570,12 @@ class HashAggregateExec(Exec):
                 partial = merge(batch) if self.mode == "final" \
                     else update(batch, jnp.asarray(offset, jnp.int64))
                 offset += batch.capacity
-                # Shrink each merged partial to its group-count bucket
-                # (one output-size sync per batch — the same sync cuDF's
-                # groupby does) so the running accumulator concat+re-merge
-                # runs at GROUP scale, not input scale. Without this the
-                # accumulator's capacity grows by every input batch.
-                k = max(int(partial.num_rows), 1)
-                partial = shrink_to_capacity(partial, bucket_capacity(k))
                 if acc is None:
                     acc = partial
                 else:
                     cap = bucket_capacity(acc.capacity + partial.capacity)
                     acc = merge(jit_concat_batches([acc, partial], cap))
+                if acc.capacity > shrink_at:
                     k = max(int(acc.num_rows), 1)
                     acc = shrink_to_capacity(acc, bucket_capacity(k))
         if not saw_input or acc is None:
@@ -580,6 +583,10 @@ class HashAggregateExec(Exec):
                 yield self._empty_result()
             return
         with timed(m):
+            # One final shrink so the yielded batch (and any collect
+            # download) is at group scale, not input scale.
+            k = max(int(acc.num_rows), 1)
+            acc = shrink_to_capacity(acc, bucket_capacity(k))
             if self.mode in ("final", "complete"):
                 acc = finalize(acc)
         m.add("numOutputBatches", 1)

@@ -26,6 +26,35 @@ from spark_rapids_tpu.exprs.nondeterministic import (
 from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
 
 
+def _input_file_key(op: Exec, partition: int, host: bool = False
+                    ) -> Optional[str]:
+    """Cache key under which this operator's (unique) descendant file scan
+    publishes the current file path. Scans scope their keys by instance so
+    two scans sharing a partition can't clobber each other; if this subtree
+    has zero or multiple scans there is no well-defined "current input
+    file" and input_file_name() yields '' (reference behavior for
+    non-scan inputs, GpuInputFileBlock.scala)."""
+    scans = []
+
+    def walk(node):
+        if type(node).__name__ == "FileScanExec":
+            scans.append(node)
+            return
+        # An exchange breaks the batch<->file association: rows in a
+        # post-shuffle batch mix every map partition's files, so
+        # input_file_name() above one is '' (Spark behavior).
+        if "Exchange" in type(node).__name__:
+            return
+        for ch in getattr(node, "children", ()):
+            walk(ch)
+
+    walk(op)
+    if len(scans) != 1:
+        return None
+    prefix = "input_file_host" if host else "input_file"
+    return f"{prefix}:{id(scans[0])}:{partition}"
+
+
 def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
                             kernel, ctx: ExecContext, partition: int):
     """Drive ``kernel(batch)`` over the child's batches with an EvalContext
@@ -55,9 +84,10 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
             yield out
     else:
         base = 0
+        key = _input_file_key(op, partition)
         for batch in op.children[0].execute_device(ctx, partition):
             ec = EvalContext(partition, base,
-                             ctx.cache.get(f"input_file:{partition}"))
+                             ctx.cache.get(key) if key else None)
             with timed(m), eval_context(ec):
                 out = kernel(batch)
             base = base + batch.num_rows.astype(jnp.int64)
@@ -68,9 +98,10 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
 def _contextual_host_loop(op: Exec, kernel, ctx: ExecContext,
                           partition: int):
     base = 0
+    key = _input_file_key(op, partition, host=True)
     for hb in op.children[0].execute_host(ctx, partition):
         ec = EvalContext(partition, base,
-                         ctx.cache.get(f"input_file_host:{partition}"))
+                         ctx.cache.get(key) if key else None)
         with eval_context(ec):
             yield kernel(hb)
         base += hb.num_rows

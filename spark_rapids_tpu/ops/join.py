@@ -51,7 +51,11 @@ JOIN_TYPES = ("inner", "left", "right", "full", "semi", "anti", "cross")
 
 @dataclasses.dataclass
 class BuiltSide:
-    """Build side prepared for probing: rows sorted by key fingerprint."""
+    """Build side prepared for probing: rows sorted by key fingerprint.
+
+    Registered as a jax pytree so whole probe steps can be jitted with the
+    built side passed as a traced argument (one compile serves every
+    partition)."""
 
     batch: DeviceBatch          # rows in fingerprint-sorted order
     fp: jnp.ndarray             # (cap,) uint64 sorted fingerprints
@@ -60,6 +64,31 @@ class BuiltSide:
     num_rows: jnp.ndarray       # int32
     key_ordinals: Optional[List[int]] = None  # for post-match verification
     null_safe: bool = False
+    # Max matchable rows sharing one fingerprint (device scalar). Synced
+    # once per build: when small, any probe batch's join output fits in
+    # probe_cap * max_run, so the per-probe-batch output-size sync (the
+    # cuDF join size computation) is skipped entirely — the FK-join fast
+    # path. None for nested-loop builds.
+    max_run: Optional[jnp.ndarray] = None
+
+
+def _builtside_flatten(bs: "BuiltSide"):
+    children = (bs.batch, bs.fp, bs.matchable, bs.row_live, bs.num_rows,
+                bs.max_run)
+    aux = (tuple(bs.key_ordinals) if bs.key_ordinals is not None else None,
+           bs.null_safe)
+    return children, aux
+
+
+def _builtside_unflatten(aux, children):
+    ko, ns = aux
+    batch, fp, matchable, row_live, num_rows, max_run = children
+    return BuiltSide(batch, fp, matchable, row_live, num_rows,
+                     list(ko) if ko is not None else None, ns, max_run)
+
+
+jax.tree_util.register_pytree_node(
+    BuiltSide, _builtside_flatten, _builtside_unflatten)
 
 
 def _fingerprint64(batch: DeviceBatch, key_ordinals) -> jnp.ndarray:
@@ -88,9 +117,21 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
     cols = tuple(c.gather(perm.astype(jnp.int32), s_live)
                  for c in batch.columns)
     sorted_batch = DeviceBatch(cols, batch.num_rows)
-    return BuiltSide(sorted_batch, jnp.take(key, perm, axis=0),
-                     jnp.take(matchable, perm, axis=0), s_live,
-                     batch.num_rows, list(key_ordinals), null_safe)
+    s_fp = jnp.take(key, perm, axis=0)
+    s_match = jnp.take(matchable, perm, axis=0)
+    # Longest run of equal sorted fingerprints among matchable rows (the
+    # sentinel run at the end is excluded via s_match).
+    cap = batch.capacity
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    starts = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                              s_fp[1:] != s_fp[:-1]])
+    last_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(starts, idx, 0))
+    run_pos = idx - last_start
+    max_run = jnp.max(jnp.where(s_match, run_pos + 1, 0))
+    return BuiltSide(sorted_batch, s_fp, s_match, s_live,
+                     batch.num_rows, list(key_ordinals), null_safe,
+                     max_run)
 
 
 def _pair_keys_equal(built: BuiltSide, b_idx: jnp.ndarray,
@@ -196,6 +237,46 @@ class _JoinKernelMixin:
     """Shared device join logic over a built (single-batch) build side and a
     streamed probe side. Subclasses decide which input is which."""
 
+    # Fast path bound: with max_run <= this, output capacity is taken as
+    # probe_cap * max_run with NO per-probe-batch size sync. Beyond it the
+    # padding waste outweighs the saved round trip.
+    _FAST_PATH_MAX_RUN = 4
+
+    def _probe_jit_fn(self):
+        """One jitted probe step per exec instance: fingerprint search +
+        expansion + gathers fused into a single device program (one
+        dispatch per probe batch instead of dozens of eager primitives).
+        BuiltSide is a pytree argument, so all partitions share the
+        compile."""
+        if getattr(self, "_probe_jit", None) is None:
+            def step(built, pbatch, out_cap, build_is_right, probe_keys):
+                lo, counts, plive = probe_ranges(built, pbatch,
+                                                 list(probe_keys),
+                                                 built.null_safe)
+                return self._emit_expanded(
+                    built, pbatch, lo, counts, plive, out_cap,
+                    build_is_right, list(probe_keys))
+            self._probe_jit = jax.jit(
+                step, static_argnames=("out_cap", "build_is_right",
+                                       "probe_keys"))
+        return self._probe_jit
+
+    def _emit_jit_fn(self):
+        """Jitted expansion for the synced (max_run > fast bound) path: the
+        ranges were already computed eagerly to size the output, so this
+        variant takes them as traced arguments instead of re-hashing the
+        probe keys and re-searching the build fingerprints."""
+        if getattr(self, "_emit_jit", None) is None:
+            def step(built, pbatch, lo, counts, plive, out_cap,
+                     build_is_right, probe_keys):
+                return self._emit_expanded(
+                    built, pbatch, lo, counts, plive, out_cap,
+                    build_is_right, list(probe_keys))
+            self._emit_jit = jax.jit(
+                step, static_argnames=("out_cap", "build_is_right",
+                                       "probe_keys"))
+        return self._emit_jit
+
     def _device_join_stream(self, ctx, built: BuiltSide, probe_iter,
                             probe_keys, build_is_right: bool):
         jt = self.join_type
@@ -205,15 +286,44 @@ class _JoinKernelMixin:
         # stream and unmatched build rows are emitted once at the end.
         covered_acc = jnp.zeros((build_cap,), jnp.bool_) \
             if jt == "full" else None
+        # One sync per BUILD (not per probe batch): FK-style joins
+        # (unique/near-unique build keys) size every probe batch's output
+        # as probe_cap * max_run with no further syncs.
+        mr = int(built.max_run) if built.max_run is not None else None
+        fast = mr is not None and 0 < mr <= self._FAST_PATH_MAX_RUN
+        jittable = cond is None or getattr(cond, "jittable", False)
         for pbatch in probe_iter:
-            lo, counts, plive = probe_ranges(built, pbatch, probe_keys)
-            # (Semi/anti also go through expansion: candidate fingerprint
-            # ranges must be key-verified before deciding hit/miss.)
-            total = int(jnp.sum(counts))
-            out_cap = bucket_capacity(max(total, 1))
-            out, covered = self._emit_expanded(
-                built, pbatch, lo, counts, plive, out_cap, build_is_right,
-                probe_keys)
+            if fast:
+                out_cap = bucket_capacity(max(pbatch.capacity * mr, 1))
+                if jittable:
+                    out, covered = self._probe_jit_fn()(
+                        built, pbatch, out_cap=out_cap,
+                        build_is_right=build_is_right,
+                        probe_keys=tuple(probe_keys))
+                else:
+                    lo, counts, plive = probe_ranges(
+                        built, pbatch, probe_keys, built.null_safe)
+                    out, covered = self._emit_expanded(
+                        built, pbatch, lo, counts, plive, out_cap,
+                        build_is_right, probe_keys)
+            else:
+                # (Semi/anti also go through expansion: candidate
+                # fingerprint ranges must be key-verified before deciding
+                # hit/miss.) The eagerly-computed ranges are reused by the
+                # emit step — probe keys are hashed once per batch.
+                lo, counts, plive = probe_ranges(built, pbatch, probe_keys,
+                                                 built.null_safe)
+                total = int(jnp.sum(counts))
+                out_cap = bucket_capacity(max(total, 1))
+                if jittable:
+                    out, covered = self._emit_jit_fn()(
+                        built, pbatch, lo, counts, plive, out_cap=out_cap,
+                        build_is_right=build_is_right,
+                        probe_keys=tuple(probe_keys))
+                else:
+                    out, covered = self._emit_expanded(
+                        built, pbatch, lo, counts, plive, out_cap,
+                        build_is_right, probe_keys)
             if covered_acc is not None and covered is not None:
                 covered_acc = covered_acc | covered
             yield out

@@ -148,14 +148,62 @@ class ShuffleExchangeExec(Exec):
         # legitimately re-execute a partition (range-bounds sampling,
         # broadcast probe re-runs). Consumed buckets carry the lowest spill
         # priority, so they are the first evicted under pressure.
+        #
+        # Post-shuffle COALESCE (GpuCoalesceBatches after an exchange,
+        # GpuCoalesceBatches.scala:643): a reduce partition receives one
+        # piece per map batch — typically many small batches. Serving them
+        # individually makes every downstream per-batch host sync (agg
+        # shrink, join size read) pay a device round trip PER PIECE; concat
+        # groups of pieces up to batchSizeRows into one batch first. The
+        # grouping keys off host-known static capacities — zero syncs.
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.columnar.batch import jit_concat_batches
         from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
         buckets = self._materialize_device(ctx)
-        for sb in buckets[partition]:
+        target = int(ctx.conf.get(C.BATCH_SIZE_ROWS))
+        group: List = []
+        group_cap = 0
+
+        def flush(sbs):
+            """Returns (batch_to_yield, handles_to_release_after_consume).
+            A concat produces a NEW batch, so the source handles release
+            immediately (jax keeps their buffers alive for the in-flight
+            concat); a passed-through single batch IS the catalog-resident
+            batch and must stay ACTIVE until the consumer is done with it,
+            or it becomes the top spill victim mid-use."""
+            if len(sbs) == 1:
+                return sbs[0].get(), sbs
+            batches = [sb.get() for sb in sbs]
+            cap = bucket_capacity(sum(b.capacity for b in batches))
+            out = jit_concat_batches(batches, cap)
+            for sb in sbs:
+                sb.release(PRIORITY_SHUFFLE_OUTPUT)
+            return out, []
+
+        def serve(sbs):
+            out, pending = flush(sbs)
             try:
-                yield sb.get()
+                yield out
             finally:
-                # Runs on normal resume AND on early generator close, so an
-                # abandoned consumer (limit) never pins a batch as ACTIVE.
+                # Runs when the consumer resumes (or abandons) the stream,
+                # so the served batch is never evictable while in use.
+                for sb in pending:
+                    sb.release(PRIORITY_SHUFFLE_OUTPUT)
+
+        try:
+            for sb in buckets[partition]:
+                if group and group_cap + sb.capacity > target:
+                    yield from serve(group)
+                    group, group_cap = [], 0
+                group.append(sb)
+                group_cap += sb.capacity
+            if group:
+                yield from serve(group)
+                group = []
+        finally:
+            # Early generator close before serve() ran: release anything
+            # still grouped so no batch stays pinned ACTIVE.
+            for sb in group:
                 sb.release(PRIORITY_SHUFFLE_OUTPUT)
 
     def execute_host(self, ctx, partition):
