@@ -3,6 +3,14 @@
 from spark_rapids_tpu.api.dataframe import (    # noqa: F401
     DataFrame, DataFrameReader, GroupedData, TpuSession)
 from spark_rapids_tpu.plan.logical import (     # noqa: F401
-    agg_avg, agg_count, agg_first, agg_last, agg_max, agg_min, agg_sum,
-    col, concat, input_file_name, lit_col, lower, monotonically_increasing_id,
-    rand, spark_partition_id, upper, when)
+    add_months, agg_avg, agg_avg_distinct, agg_count, agg_count_distinct,
+    agg_first, agg_last, agg_max, agg_min, agg_sum, agg_sum_distinct,
+    bround_col, ceil_col, col, concat, concat_ws, date_add, date_sub,
+    datediff, dayofmonth, dayofweek, dayofyear, exp_col, floor_col,
+    from_unixtime, greatest, hour, initcap, input_file_name, instr,
+    isnan_col, last_day, least, length, lit_col, locate, log10_col, log_col,
+    log2_col, lower, lpad, ltrim, minute, monotonically_increasing_id,
+    month, nanvl, pmod, pow_col, quarter, rand, regexp_extract, repeat,
+    replace_str, reverse, round_col, rpad, rtrim, second, signum_col,
+    spark_partition_id, sqrt_col, to_unix_timestamp, translate, trim,
+    trunc, upper, weekday, when, year)

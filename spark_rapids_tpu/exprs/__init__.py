@@ -16,9 +16,9 @@ from spark_rapids_tpu.exprs.predicates import (  # noqa: F401
     And, EqualNullSafe, EqualTo, GreaterThan, GreaterThanOrEqual, InSet,
     IsNan, IsNotNull, IsNull, LessThan, LessThanOrEqual, Not, Or)
 from spark_rapids_tpu.exprs.math import (        # noqa: F401
-    Acos, Asin, Atan, Atan2, Cbrt, Ceil, Cos, Cosh, Exp, Expm1, Floor, Log,
-    Log1p, Log2, Log10, Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh,
-    ToDegrees, ToRadians)
+    Acos, Asin, Atan, Atan2, BRound, Cbrt, Ceil, Cos, Cosh, Exp, Expm1,
+    Floor, Log, Log1p, Log2, Log10, Pow, Rint, Round, Signum, Sin, Sinh,
+    Sqrt, Tan, Tanh, ToDegrees, ToRadians)
 from spark_rapids_tpu.exprs.conditional import (  # noqa: F401
     CaseWhen, Coalesce, If, KnownFloatingPointNormalized, NaNvl,
     NormalizeNaNAndZero, Nvl)
@@ -26,11 +26,13 @@ from spark_rapids_tpu.exprs.cast import Cast      # noqa: F401
 from spark_rapids_tpu.exprs.datetime import (     # noqa: F401
     AddMonths, DateAdd, DateDiff, DateSub, DayOfMonth, DayOfWeek, DayOfYear,
     FromUnixTime, Hour, LastDay, Minute, Month, Quarter, Second, TimeAdd,
-    TimeSub, ToUnixTimestamp, UnixTimestamp, WeekDay, Year)
+    TimeSub, ToUnixTimestamp, TruncDate, UnixTimestamp, WeekDay, Year)
 from spark_rapids_tpu.exprs.strings import (      # noqa: F401
-    ConcatStrings, Contains, EndsWith, Length, Like, Lower, RegExpReplace,
-    StartsWith, StringLocate, StringReplace, StringTrim, StringTrimLeft,
-    StringTrimRight, Substring, Upper)
+    ConcatStrings, ConcatWs, Contains, EndsWith, InitCap, Length, Like,
+    Lower, RegExpExtract, RegExpReplace, StartsWith, StringLocate,
+    StringLPad, StringRepeat, StringReplace, StringReverse, StringRPad,
+    StringTrim, StringTrimLeft, StringTrimRight, Substring, Translate,
+    Upper)
 from spark_rapids_tpu.exprs.hash import Murmur3Hash  # noqa: F401
 from spark_rapids_tpu.exprs.nondeterministic import (  # noqa: F401
     EvalContext, InputFileName, MonotonicallyIncreasingID, Rand,

@@ -154,6 +154,45 @@ class Second(_TimePart):
         return xp.remainder(secs, 60).astype(np.int32)
 
 
+class TruncDate(UnaryExpression):
+    """trunc(date, fmt) for fmt in year/yyyy/yy, quarter, month/mon/mm,
+    week (Monday start, Spark semantics); ref GpuTruncDate."""
+
+    _FMT = {"year": "year", "yyyy": "year", "yy": "year",
+            "quarter": "quarter",
+            "month": "month", "mon": "month", "mm": "month",
+            "week": "week"}
+
+    def __init__(self, child, fmt: str):
+        super().__init__(child)
+        from spark_rapids_tpu.exprs.base import Literal
+        if isinstance(fmt, Literal):
+            fmt = fmt.value
+        if isinstance(fmt, bytes):
+            fmt = fmt.decode()
+        self.fmt = self._FMT.get(str(fmt).lower())
+
+    def data_type(self) -> DataType:
+        return dt.DATE
+
+    def do_columnar(self, xp, data, validity, col):
+        days = _days_of(xp, data, self.child.data_type())
+        if self.fmt is None:
+            # Unknown format -> NULL (Spark behavior).
+            return days.astype(np.int32), validity & False
+        if self.fmt == "week":
+            # Monday of the current week; epoch day 0 was a Thursday.
+            dow = xp.remainder(days.astype(np.int64) + 3, 7)
+            return (days - dow).astype(np.int32), validity
+        y, m, d = civil_from_days(xp, days)
+        if self.fmt == "year":
+            m = xp.ones_like(m)
+        elif self.fmt == "quarter":
+            m = (_fdiv(xp, m - 1, 3) * 3 + 1).astype(m.dtype)
+        out = days_from_civil(xp, y, m, xp.ones_like(d))
+        return out.astype(np.int32), validity
+
+
 class DateAdd(BinaryExpression):
     """date_add(date, n days)."""
 

@@ -231,3 +231,25 @@ class Round(UnaryExpression):
         r = xp.where(scaled >= 0, xp.floor(scaled + 0.5),
                      xp.ceil(scaled - 0.5)) / factor
         return r, validity
+
+
+class BRound(Round):
+    """bround(x, d): HALF_EVEN (banker's rounding), ref GpuBRound."""
+
+    def do_columnar(self, xp, data, validity, col):
+        t = self.data_type()
+        if t.is_integral:
+            if self.scale >= 0:
+                return data, validity
+            factor = np.int64(10) ** np.int64(-self.scale)
+            x = data.astype(np.int64)
+            q = xp.floor_divide(x, factor)        # floor: rem in [0, factor)
+            rem = x - q * factor
+            up = (2 * rem > factor) | ((2 * rem == factor) &
+                                       (xp.remainder(q, 2) != 0))
+            r = (q + up.astype(np.int64)) * factor
+            return r.astype(t.np_dtype), validity
+        factor = 10.0 ** self.scale
+        x = data.astype(np.float64)
+        # numpy/jax round() is half-to-even natively.
+        return xp.round(x * factor) / factor, validity

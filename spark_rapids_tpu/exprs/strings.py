@@ -368,7 +368,8 @@ class ConcatStrings(Expression):
     def data_type(self) -> DataType:
         return dt.STRING
 
-    def _concat2(self, xp, a_data, a_len, b_data, b_len):
+    @staticmethod
+    def _concat2(xp, a_data, a_len, b_data, b_len):
         n = a_data.shape[0]
         wa, wb = a_data.shape[1], b_data.shape[1]
         w = wa + wb
@@ -540,6 +541,282 @@ class RegExpReplace(_HostStringOp):
             out[i] = self.pattern.sub(self.replacement, values[i]) \
                 if validity[i] else b""
         return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, s1, s2, ...): joins NON-null inputs with the literal
+    separator; null inputs are skipped and the result is never null
+    (Spark concat_ws; ref GpuConcatWs). Device kernel reuses the running
+    two-way concat with per-row conditional lengths — null pieces and
+    their separators contribute zero bytes."""
+
+    def __init__(self, sep: str, *children: Expression):
+        self.sep = sep.encode() if isinstance(sep, str) else bytes(sep)
+        self._children = tuple(children)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def _run(self, xp, cols):
+        """cols: [(data, lengths, validity)]; returns (data, lengths)."""
+        n = cols[0][0].shape[0]
+        sep = np.frombuffer(self.sep, np.uint8)
+        ws = len(sep)
+        cc = ConcatStrings
+        acc_data = xp.zeros((n, 1), np.uint8)
+        acc_len = xp.zeros((n,), np.int32)
+        has_prev = xp.zeros((n,), np.bool_)
+        for d, l, v in cols:
+            eff_len = xp.where(v, l, 0).astype(np.int32)
+            if ws:
+                sep_len = xp.where(has_prev & v, ws, 0).astype(np.int32)
+                sep_data = xp.broadcast_to(
+                    xp.asarray(sep)[None, :], (n, ws)).astype(np.uint8)
+                acc_data, acc_len = cc._concat2(xp, acc_data, acc_len,
+                                                sep_data, sep_len)
+            acc_data, acc_len = cc._concat2(xp, acc_data, acc_len,
+                                            d, eff_len)
+            has_prev = has_prev | v
+        return acc_data, acc_len
+
+    def eval(self, batch):
+        if not self._children:
+            n = batch.capacity
+            return make_column(dt.STRING, jnp.zeros((n, 1), np.uint8),
+                               jnp.ones((n,), jnp.bool_),
+                               jnp.zeros((n,), jnp.int32))
+        cols = []
+        for c in self._children:
+            col = as_device_column(c.eval(batch), batch)
+            cols.append((col.data, col.lengths, col.validity))
+        data, lengths = self._run(jnp, cols)
+        valid = jnp.ones((batch.capacity,), jnp.bool_)
+        return make_column(dt.STRING, data, valid, lengths)
+
+    def eval_host(self, batch):
+        if not self._children:
+            n = batch.num_rows
+            out = np.full(n, b"", dtype=object)
+            return HostColumn(dt.STRING, out, np.ones(n, np.bool_))
+        cols = []
+        for c in self._children:
+            col = as_host_column(c.eval_host(batch), batch)
+            m, lens = _host_to_matrix(col)
+            cols.append((m, lens, col.validity))
+        data, lengths = self._run(np, cols)
+        valid = np.ones((len(lengths),), np.bool_)
+        return _matrix_to_host(data, lengths, valid)
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) with literal n (ref GpuStringRepeat)."""
+
+    def __init__(self, child: Expression, n: int):
+        from spark_rapids_tpu.exprs.base import Literal
+        if isinstance(n, Literal):
+            n = n.value
+        self.child = child
+        self.n = max(int(n), 0)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def _kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        k = self.n
+        if k == 0 or w == 0:
+            n = data.shape[0]
+            return xp.zeros((n, 1), np.uint8), xp.zeros((n,), np.int32)
+        out_w = w * k
+        j = xp.arange(out_w, dtype=np.int32)[None, :]
+        src = xp.remainder(j, xp.maximum(lengths[:, None], 1))
+        out = xp.take_along_axis(data, src, axis=1)
+        out_len = (lengths * k).astype(np.int32)
+        live = j < out_len[:, None]
+        return xp.where(live, out, 0), out_len
+
+    def eval(self, batch):
+        col = as_device_column(self.child.eval(batch), batch)
+        data, lengths = self._kernel(jnp, col.data, col.lengths,
+                                     col.validity)
+        return make_column(dt.STRING, data, col.validity, lengths)
+
+    def eval_host(self, batch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        m, lens = _host_to_matrix(col)
+        data, lengths = self._kernel(np, m, lens, col.validity)
+        return _matrix_to_host(data, lengths, col.validity)
+
+
+class StringReverse(StringUnary):
+    """reverse(str): character-level (UTF-8 aware) reversal via a per-row
+    argsort on (reversed char ordinal, byte offset within char)."""
+
+    def kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        if w == 0:
+            return data, lengths, validity
+        idx = xp.arange(w, dtype=np.int32)[None, :]
+        inside = byte_mask(xp, w, lengths)
+        starts = char_starts(xp, data, lengths)
+        char_ord = xp.cumsum(starts.astype(np.int32), axis=1) - 1
+        # byte offset within its codepoint: distance from last start <= j.
+        start_pos = xp.where(starts, idx, -1)
+        if xp is np:
+            last_start = np.maximum.accumulate(start_pos, axis=1)
+        else:
+            import jax
+            last_start = jax.lax.associative_scan(jnp.maximum, start_pos,
+                                                  axis=1)
+        within = idx - last_start
+        nchars = starts.sum(axis=1).astype(np.int32)
+        key = xp.where(inside,
+                       (nchars[:, None] - 1 - char_ord) * (w + 1) + within,
+                       np.int32(2) * w * (w + 1))
+        if xp is np:
+            order = np.argsort(key, axis=1, kind="stable")
+        else:
+            order = xp.argsort(key, axis=1, stable=True)
+        out = xp.take_along_axis(data, order.astype(np.int32), axis=1)
+        live = idx < lengths[:, None]
+        return xp.where(live, out, 0), lengths, validity
+
+
+class InitCap(StringUnary):
+    """initcap(): first letter of each space-separated word uppercased,
+    the rest lowercased (ASCII; same locale incompat as upper/lower)."""
+
+    def kernel(self, xp, data, lengths, validity):
+        w = data.shape[1]
+        prev = xp.concatenate(
+            [xp.full((data.shape[0], 1), 0x20, data.dtype),
+             data[:, :-1]], axis=1)
+        word_start = prev == 0x20
+        is_lower = (data >= ord("a")) & (data <= ord("z"))
+        is_upper = (data >= ord("A")) & (data <= ord("Z"))
+        up = xp.where(word_start & is_lower, data - 32, data)
+        out = xp.where(~word_start & is_upper, up + 32, up)
+        # Only the cased transform differs; bytes outside length are 0.
+        out = xp.where(byte_mask(xp, w, lengths), out, 0)
+        return out, lengths, validity
+
+
+class RegExpExtract(_HostStringOp):
+    """regexp_extract(str, pattern, idx): group idx of the first match,
+    '' when no match (Spark semantics; host engine, python re — the
+    reference draws the same host boundary for unsupported cudf regex)."""
+
+    def __init__(self, child: Expression, pattern: str, idx: int = 1):
+        import re
+        self.child = child
+        self.pattern = re.compile(pattern)
+        self.idx = int(idx)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _host_kernel(self, values, validity):
+        n = len(values)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = b""
+                continue
+            m = self.pattern.search(values[i].decode("utf-8", "replace"))
+            if m is None:
+                out[i] = b""
+            else:
+                g = m.group(self.idx)
+                out[i] = (g or "").encode()
+        return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class Translate(_HostStringOp):
+    """translate(str, from, to): per-character mapping; chars beyond
+    len(to) are deleted (Spark semantics)."""
+
+    def __init__(self, child: Expression, src: str, to: str):
+        self.child = child
+        self.table = {}
+        for i, ch in enumerate(src):
+            if ch not in self.table:
+                self.table[ch] = to[i] if i < len(to) else None
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _host_kernel(self, values, validity):
+        n = len(values)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = b""
+                continue
+            s = values[i].decode("utf-8", "replace")
+            buf = []
+            for ch in s:
+                r = self.table.get(ch, ch)
+                if r is not None:
+                    buf.append(r)
+            out[i] = "".join(buf).encode()
+        return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class _StringPad(_HostStringOp):
+    """lpad/rpad(str, len, pad): character-addressed pad/truncate
+    (GpuStringLPad/RPad). Host kernel (exact char semantics)."""
+
+    left = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        from spark_rapids_tpu.exprs.base import Literal
+        if isinstance(length, Literal):
+            length = length.value
+        self.child = child
+        self.length = int(length)
+        self.pad = pad
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _host_kernel(self, values, validity):
+        n = len(values)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if not validity[i]:
+                out[i] = b""
+                continue
+            s = values[i].decode("utf-8", "replace")
+            want = self.length
+            if want <= 0:
+                out[i] = b""
+            elif len(s) >= want:
+                out[i] = s[:want].encode()
+            elif not self.pad:
+                out[i] = s.encode()
+            else:
+                fill = (self.pad * want)[:want - len(s)]
+                out[i] = (fill + s if self.left else s + fill).encode()
+        return HostColumn(dt.STRING, out, np.asarray(validity, np.bool_))
+
+
+class StringLPad(_StringPad):
+    left = True
+
+
+class StringRPad(_StringPad):
+    left = False
 
 
 class Like(Expression):

@@ -387,10 +387,13 @@ class Last(First):
 
 @dataclasses.dataclass
 class AggSpec:
-    """A named aggregate in the output (result column)."""
+    """A named aggregate in the output (result column). ``distinct`` is
+    consumed by mixed_final mode: the fn runs UPDATE over the deduped
+    distinct input instead of MERGE over partial buffers."""
 
     name: str
     fn: AggFunction
+    distinct: bool = False
 
 
 # ---------------------------------------------------------------------------
@@ -409,7 +412,13 @@ class HashAggregateExec(Exec):
                  aggregates: Sequence[AggSpec],
                  mode: str = "complete"):
         super().__init__(child)
-        assert mode in ("partial", "final", "complete")
+        # 'merge' = final minus the result projection (emits buffers);
+        # 'mixed_final' = the distinct combo stage: input layout is
+        # [keys..., distinct_x, nd buffers...]; distinct specs UPDATE over
+        # x, the rest MERGE their buffers (aggregate.scala:305 distinct
+        # partial-merge mode combos).
+        assert mode in ("partial", "final", "complete", "merge",
+                        "mixed_final")
         self.group_names = tuple(n for n, _ in group_by)
         self.group_exprs = [e for _, e in group_by]
         self.aggs = list(aggregates)
@@ -428,7 +437,7 @@ class HashAggregateExec(Exec):
 
     @property
     def schema(self) -> Schema:
-        if self.mode == "partial":
+        if self.mode in ("partial", "merge"):
             return self.buffer_schema
         cols = [(n, e.data_type())
                 for n, e in zip(self.group_names, self.group_exprs)]
@@ -520,6 +529,36 @@ class HashAggregateExec(Exec):
             ci += nbuf
         return DeviceBatch(tuple(out_cols), g.num_groups)
 
+    def _mixed_batch(self, batch: DeviceBatch) -> DeviceBatch:
+        """Distinct combo stage: input [keys..., x, nd buffers...] with
+        (keys, x) already unique; group by keys only; distinct specs
+        update over x, others merge buffers. Output is the standard
+        buffer layout [keys..., all buffers...]."""
+        cap = batch.capacity
+        g = kernels.group_ids(batch, range(self._nkeys))
+        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
+        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
+        out_cols: List[DeviceColumn] = []
+        for ki in range(self._nkeys):
+            out_cols.append(batch.columns[ki].gather(g.group_leader, gmask))
+        x_ord = self._nkeys
+        ci = self._nkeys + 1            # nd buffers follow the x column
+        row_index = g.perm.astype(jnp.int64)
+        for spec in self.aggs:
+            if spec.distinct:
+                col = self._sorted_col(batch.columns[x_ord], g.perm, slive)
+                bufs = spec.fn.update(col, g.group_of_sorted, cap,
+                                      row_index)
+            else:
+                nbuf = len(spec.fn.buffer_types)
+                ins = [self._sorted_col(batch.columns[ci + b], g.perm,
+                                        slive) for b in range(nbuf)]
+                bufs = spec.fn.merge(ins, g.group_of_sorted, cap)
+                ci += nbuf
+            for buf, bt in zip(bufs, spec.fn.buffer_types):
+                out_cols.append(self._buf_column(buf, bt, gmask))
+        return DeviceBatch(tuple(out_cols), g.num_groups)
+
     def _finalize_batch(self, batch: DeviceBatch) -> DeviceBatch:
         out_cols = list(batch.columns[:self._nkeys])
         ci = self._nkeys
@@ -542,12 +581,13 @@ class HashAggregateExec(Exec):
         if not hasattr(self, "_jit_fns"):
             self._jit_fns = (jax.jit(self._update_batch),
                              jax.jit(self._merge_batch),
-                             jax.jit(self._finalize_batch))
+                             jax.jit(self._finalize_batch),
+                             jax.jit(self._mixed_batch))
         return self._jit_fns
 
     def execute_device(self, ctx, partition):
         m = ctx.metrics_for(self)
-        update, merge, finalize = self._jits()
+        update, merge, finalize, mixed = self._jits()
 
         from spark_rapids_tpu import config as C
         from spark_rapids_tpu.columnar.batch import (
@@ -566,9 +606,14 @@ class HashAggregateExec(Exec):
         for batch in self.children[0].execute_device(ctx, partition):
             saw_input = True
             with timed(m):
-                # 'final' consumes buffer batches: first pass is a merge.
-                partial = merge(batch) if self.mode == "final" \
-                    else update(batch, jnp.asarray(offset, jnp.int64))
+                # 'final'/'merge' consume buffer batches: first pass is a
+                # merge; 'mixed_final' runs the distinct combo kernel.
+                if self.mode in ("final", "merge"):
+                    partial = merge(batch)
+                elif self.mode == "mixed_final":
+                    partial = mixed(batch)
+                else:
+                    partial = update(batch, jnp.asarray(offset, jnp.int64))
                 offset += batch.capacity
                 if acc is None:
                     acc = partial
@@ -579,7 +624,8 @@ class HashAggregateExec(Exec):
                     k = max(int(acc.num_rows), 1)
                     acc = shrink_to_capacity(acc, bucket_capacity(k))
         if not saw_input or acc is None:
-            if self._nkeys == 0 and self.mode in ("final", "complete"):
+            if self._nkeys == 0 and self.mode in ("final", "complete",
+                                                  "mixed_final"):
                 yield self._empty_result()
             return
         with timed(m):
@@ -587,7 +633,7 @@ class HashAggregateExec(Exec):
             # download) is at group scale, not input scale.
             k = max(int(acc.num_rows), 1)
             acc = shrink_to_capacity(acc, bucket_capacity(k))
-            if self.mode in ("final", "complete"):
+            if self.mode in ("final", "complete", "mixed_final"):
                 acc = finalize(acc)
         m.add("numOutputBatches", 1)
         yield acc
@@ -634,8 +680,12 @@ class HashAggregateExec(Exec):
 
     def execute_host(self, ctx, partition):
         hbs = list(self.children[0].execute_host(ctx, partition))
-        if self.mode == "final":
-            yield from self._execute_host_final(hbs)
+        if self.mode in ("final", "merge"):
+            yield from self._execute_host_final(
+                hbs, do_finalize=self.mode == "final")
+            return
+        if self.mode == "mixed_final":
+            yield from self._execute_host_mixed(hbs)
             return
         key_evaluator = []
         input_lists = []
@@ -671,8 +721,9 @@ class HashAggregateExec(Exec):
             rows = [tuple(vals)]
         yield _rows_to_host_batch(rows, self.schema)
 
-    def _execute_host_final(self, hbs):
-        """Host final mode: group buffer rows by key, merge buffer tuples."""
+    def _execute_host_final(self, hbs, do_finalize: bool = True):
+        """Host final/merge mode: group buffer rows by key, merge buffer
+        tuples; 'merge' emits the merged buffers unfinalized."""
         key_evaluator = []
         buf_lists = []
         for hb in hbs:
@@ -693,8 +744,55 @@ class HashAggregateExec(Exec):
             vals = list(key_values[key])
             for ai, spec in enumerate(self.aggs):
                 merged = spec.fn.host_merge(groups[key][ai])
-                vals.append(spec.fn.host_finalize(merged))
+                if do_finalize:
+                    vals.append(spec.fn.host_finalize(merged))
+                else:
+                    vals.extend(merged)
             rows.append(tuple(vals))
+        yield _rows_to_host_batch(rows, self.schema)
+
+    def _execute_host_mixed(self, hbs):
+        """Host mixed_final: input rows are unique by (keys, x); distinct
+        specs aggregate the x values, others merge their buffers."""
+        key_evaluator = []
+        input_lists = []
+        x_ord = self._nkeys
+        for hb in hbs:
+            key_evaluator.append(list(hb.columns[:self._nkeys]))
+            xvals = hb.columns[x_ord].to_list()
+            ci = self._nkeys + 1
+            per_agg = []
+            for spec in self.aggs:
+                if spec.distinct:
+                    per_agg.append(xvals)
+                else:
+                    nbuf = len(spec.fn.buffer_types)
+                    cols = [hb.columns[ci + b].to_list()
+                            for b in range(nbuf)]
+                    per_agg.append(list(zip(*cols)) if cols else [])
+                    ci += nbuf
+            input_lists.append(per_agg)
+        order, key_values, groups = self._host_groups(hbs, key_evaluator,
+                                                      input_lists)
+        rows = []
+        for key in order:
+            vals = list(key_values[key])
+            for ai, spec in enumerate(self.aggs):
+                if spec.distinct:
+                    vals.append(spec.fn.host_agg(groups[key][ai]))
+                else:
+                    merged = spec.fn.host_merge(groups[key][ai])
+                    vals.append(spec.fn.host_finalize(merged))
+            rows.append(tuple(vals))
+        if not rows and self._nkeys == 0:
+            vals = []
+            for spec in self.aggs:
+                if spec.distinct:
+                    vals.append(spec.fn.host_agg([]))
+                else:
+                    vals.append(spec.fn.host_finalize(
+                        spec.fn.host_merge([])))
+            rows = [tuple(vals)]
         yield _rows_to_host_batch(rows, self.schema)
 
     @staticmethod

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import struct
 from typing import Iterator, List, Sequence, Tuple
 
 import jax
@@ -118,9 +119,19 @@ def sort_host_batch(hb: HostBatch, orders: Sequence[SortOrder]) -> HostBatch:
                 if col.dtype.is_string:
                     v = bytes(v)
                 elif col.dtype.is_floating:
+                    # Java Double.compare total order (Spark sort
+                    # semantics): -0.0 < 0.0, every NaN greatest — via
+                    # the sign-flipped raw-bits key, matching the device
+                    # radix sort's float-domain word transform. All NaN
+                    # bit patterns (incl. sign-bit NaN) canonicalize.
                     f = float(v)
-                    # NaN greatest: map to +inf tier.
-                    v = (1, 0.0) if np.isnan(f) else (0, f)
+                    if np.isnan(f):
+                        v = 0x7FF8000000000000
+                    else:
+                        bits = struct.unpack(
+                            "<q", struct.pack("<d", f))[0]
+                        v = bits if bits >= 0 \
+                            else bits ^ 0x7FFFFFFFFFFFFFFF
                 elif col.dtype.is_boolean:
                     v = bool(v)
                 else:

@@ -159,6 +159,20 @@ def _as_col(v) -> Column:
     return lit_col(v)
 
 
+def canonical_node(c):
+    """Hashable structural key for a Column AST. Used to decide whether two
+    unresolved expressions are the same expression (e.g. the
+    single-distinct-input restriction) — unlike Expression.pretty(), it
+    keeps every non-child constructor arg (literals, scales, pads)."""
+    if isinstance(c, Column):
+        return ("col",) + tuple(canonical_node(p) for p in c.node)
+    if isinstance(c, tuple):
+        return tuple(canonical_node(p) for p in c)
+    if isinstance(c, (list, dict, set)):
+        return repr(c)
+    return c
+
+
 # Free functions mirroring pyspark.sql.functions.
 def upper(c: Column) -> Column:
     return Column(("upper", _as_col(c)))
@@ -224,6 +238,173 @@ def murmur3_hash(*cs):
     return Column(("hash", tuple(_as_col(c) for c in cs)))
 
 
+def concat_ws(sep: str, *cs) -> Column:
+    return Column(("concat_ws", sep, tuple(_as_col(c) for c in cs)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    return Column(("regexp_extract", _as_col(c), pattern, idx))
+
+
+def translate(c, src: str, to: str) -> Column:
+    return Column(("translate", _as_col(c), src, to))
+
+
+def repeat(c, n: int) -> Column:
+    return Column(("repeat", _as_col(c), n))
+
+
+def reverse(c) -> Column:
+    return Column(("reverse", _as_col(c)))
+
+
+def initcap(c) -> Column:
+    return Column(("initcap", _as_col(c)))
+
+
+def lpad(c, length: int, pad: str = " ") -> Column:
+    return Column(("lpad", _as_col(c), length, pad))
+
+
+def rpad(c, length: int, pad: str = " ") -> Column:
+    return Column(("rpad", _as_col(c), length, pad))
+
+
+def trim(c) -> Column:
+    return Column(("trim", _as_col(c)))
+
+
+def ltrim(c) -> Column:
+    return Column(("ltrim", _as_col(c)))
+
+
+def rtrim(c) -> Column:
+    return Column(("rtrim", _as_col(c)))
+
+
+def locate(needle: str, c, pos: int = 1) -> Column:
+    return Column(("locate", _as_col(c), needle, pos))
+
+
+def instr(c, needle: str) -> Column:
+    return Column(("locate", _as_col(c), needle, 1))
+
+
+def replace_str(c, search: str, repl: str) -> Column:
+    return Column(("replace", _as_col(c), search, repl))
+
+
+def bround_col(c, scale: int = 0) -> Column:
+    return Column(("bround", _as_col(c), scale))
+
+
+def floor_col(c) -> Column:
+    return Column(("floor", _as_col(c)))
+
+
+def ceil_col(c) -> Column:
+    return Column(("ceil", _as_col(c)))
+
+
+def exp_col(c) -> Column:
+    return Column(("exp", _as_col(c)))
+
+
+def log_col(c) -> Column:
+    return Column(("log", _as_col(c)))
+
+
+def log10_col(c) -> Column:
+    return Column(("log10", _as_col(c)))
+
+
+def log2_col(c) -> Column:
+    return Column(("log2", _as_col(c)))
+
+
+def pow_col(c, p) -> Column:
+    return Column(("pow", _as_col(c), _as_col(p)))
+
+
+def signum_col(c) -> Column:
+    return Column(("signum", _as_col(c)))
+
+
+def isnan_col(c) -> Column:
+    return Column(("isnan", _as_col(c)))
+
+
+def nanvl(c, fallback) -> Column:
+    return Column(("nanvl", _as_col(c), _as_col(fallback)))
+
+
+def least(*cs) -> Column:
+    return Column(("least", tuple(_as_col(c) for c in cs)))
+
+
+def greatest(*cs) -> Column:
+    return Column(("greatest", tuple(_as_col(c) for c in cs)))
+
+
+def pmod(c, d) -> Column:
+    return Column(("pmod", _as_col(c), _as_col(d)))
+
+
+def _unary_fn(kind):
+    def f(c):
+        return Column((kind, _as_col(c)))
+    f.__name__ = kind
+    return f
+
+
+sin_col = _unary_fn("sin")
+cos_col = _unary_fn("cos")
+tan_col = _unary_fn("tan")
+asin_col = _unary_fn("asin")
+acos_col = _unary_fn("acos")
+atan_col = _unary_fn("atan")
+sinh_col = _unary_fn("sinh")
+cosh_col = _unary_fn("cosh")
+tanh_col = _unary_fn("tanh")
+cbrt_col = _unary_fn("cbrt")
+expm1_col = _unary_fn("expm1")
+log1p_col = _unary_fn("log1p")
+degrees_col = _unary_fn("degrees")
+radians_col = _unary_fn("radians")
+rint_col = _unary_fn("rint")
+
+quarter = _unary_fn("quarter")
+dayofweek = _unary_fn("dayofweek")
+weekday = _unary_fn("weekday")
+dayofyear = _unary_fn("dayofyear")
+last_day = _unary_fn("last_day")
+hour = _unary_fn("hour")
+minute = _unary_fn("minute")
+second = _unary_fn("second")
+to_unix_timestamp = _unary_fn("to_unix_timestamp")
+from_unixtime = _unary_fn("from_unixtime")
+
+
+def date_add(c, n) -> Column:
+    return Column(("date_add", _as_col(c), _as_col(n)))
+
+
+def date_sub(c, n) -> Column:
+    return Column(("date_sub", _as_col(c), _as_col(n)))
+
+
+def datediff(end, start) -> Column:
+    return Column(("datediff", _as_col(end), _as_col(start)))
+
+
+def add_months(c, n) -> Column:
+    return Column(("add_months", _as_col(c), _as_col(n)))
+
+
+def trunc(c, fmt: str) -> Column:
+    return Column(("trunc", _as_col(c), fmt))
+
+
 def rand(seed: int = 0) -> Column:
     """Uniform [0,1) per row (nondeterministic; seeded per partition)."""
     return Column(("rand", int(seed)))
@@ -260,6 +441,20 @@ def agg_max(c) -> Column:
 
 def agg_avg(c) -> Column:
     return Column(("agg", "avg", _as_col(c)))
+
+
+def agg_count_distinct(c) -> Column:
+    """count(DISTINCT c) — lowered via the partial-merge mode combos of
+    aggregate.scala:305 (dedup by (keys, c), then count)."""
+    return Column(("aggd", "count", _as_col(c)))
+
+
+def agg_sum_distinct(c) -> Column:
+    return Column(("aggd", "sum", _as_col(c)))
+
+
+def agg_avg_distinct(c) -> Column:
+    return Column(("aggd", "avg", _as_col(c)))
 
 
 def agg_first(c, ignore_nulls=True) -> Column:
@@ -364,6 +559,73 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.Round(rec(node[1]), node[2])
     if kind == "hash":
         return E.Murmur3Hash([rec(x) for x in node[1]])
+    if kind == "bround":
+        return E.BRound(rec(node[1]), node[2])
+    if kind == "concat_ws":
+        return E.ConcatWs(node[1], *[rec(x) for x in node[2]])
+    if kind == "regexp_extract":
+        return E.RegExpExtract(rec(node[1]), node[2], node[3])
+    if kind == "translate":
+        return E.Translate(rec(node[1]), node[2], node[3])
+    if kind == "repeat":
+        return E.StringRepeat(rec(node[1]), node[2])
+    if kind == "reverse":
+        return E.StringReverse(rec(node[1]))
+    if kind == "initcap":
+        return E.InitCap(rec(node[1]))
+    if kind == "lpad":
+        return E.StringLPad(rec(node[1]), node[2], node[3])
+    if kind == "rpad":
+        return E.StringRPad(rec(node[1]), node[2], node[3])
+    if kind == "trim":
+        return E.StringTrim(rec(node[1]))
+    if kind == "ltrim":
+        return E.StringTrimLeft(rec(node[1]))
+    if kind == "rtrim":
+        return E.StringTrimRight(rec(node[1]))
+    if kind == "locate":
+        return E.StringLocate(E.lit(node[2]), rec(node[1]),
+                              E.lit(int(node[3])))
+    if kind == "replace":
+        return E.StringReplace(rec(node[1]), node[2], node[3])
+    if kind == "isnan":
+        return E.IsNan(rec(node[1]))
+    if kind == "nanvl":
+        return E.NaNvl(rec(node[1]), rec(node[2]))
+    if kind == "least":
+        return E.Least(*[rec(x) for x in node[1]])
+    if kind == "greatest":
+        return E.Greatest(*[rec(x) for x in node[1]])
+    if kind == "pmod":
+        return E.Pmod(rec(node[1]), rec(node[2]))
+    if kind == "pow":
+        return E.Pow(rec(node[1]), rec(node[2]))
+    _UNARY_TABLE = {
+        "floor": E.Floor, "ceil": E.Ceil, "exp": E.Exp, "log": E.Log,
+        "log10": E.Log10, "log2": E.Log2, "log1p": E.Log1p,
+        "expm1": E.Expm1, "cbrt": E.Cbrt, "sin": E.Sin, "cos": E.Cos,
+        "tan": E.Tan, "asin": E.Asin, "acos": E.Acos, "atan": E.Atan,
+        "sinh": E.Sinh, "cosh": E.Cosh, "tanh": E.Tanh,
+        "degrees": E.ToDegrees, "radians": E.ToRadians, "rint": E.Rint,
+        "signum": E.Signum,
+        "quarter": E.Quarter, "dayofweek": E.DayOfWeek,
+        "weekday": E.WeekDay, "dayofyear": E.DayOfYear,
+        "last_day": E.LastDay, "hour": E.Hour, "minute": E.Minute,
+        "second": E.Second, "to_unix_timestamp": E.ToUnixTimestamp,
+        "from_unixtime": E.FromUnixTime,
+    }
+    if kind in _UNARY_TABLE:
+        return _UNARY_TABLE[kind](rec(node[1]))
+    if kind == "date_add":
+        return E.DateAdd(rec(node[1]), rec(node[2]))
+    if kind == "date_sub":
+        return E.DateSub(rec(node[1]), rec(node[2]))
+    if kind == "datediff":
+        return E.DateDiff(rec(node[1]), rec(node[2]))
+    if kind == "add_months":
+        return E.AddMonths(rec(node[1]), rec(node[2]))
+    if kind == "trunc":
+        return E.TruncDate(rec(node[1]), node[2])
     if kind == "rand":
         return E.Rand(node[1])
     if kind == "spark_partition_id":

@@ -56,10 +56,12 @@ from spark_rapids_tpu.plan.logical import (
 _INCOMPAT_EXPRS = {
     "upper": "locale-sensitive case mapping is ASCII-only on TPU",
     "lower": "locale-sensitive case mapping is ASCII-only on TPU",
+    "initcap": "locale-sensitive case mapping is ASCII-only on TPU",
 }
 
 # Kinds that execute on the host even inside the device plan (regex etc.).
-_HOST_ROUNDTRIP_EXPRS = {"regexp_replace"}
+_HOST_ROUNDTRIP_EXPRS = {"regexp_replace", "regexp_extract", "translate",
+                         "lpad", "rpad", "replace"}
 
 # Kinds whose value depends on the task context rather than column inputs.
 _CONTEXTUAL_EXPRS = {
@@ -220,10 +222,10 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
         for _, c in plan.aggregates:
             _forbid_contextual(c, "aggregates")
             ac = _unalias(c)
-            inner = ac.node[2] if ac.node[0] == "agg" else None
+            inner = ac.node[2] if ac.node[0] in ("agg", "aggd") else None
             if inner is not None:
                 tag_column(inner, conf, reasons, notes)
-            if ac.node[0] == "agg":
+            if ac.node[0] in ("agg", "aggd"):
                 _float_agg_reasons(ac, plan.child.schema, conf, reasons)
     elif isinstance(plan, L.LogicalSort):
         for o in plan.orders:
@@ -256,25 +258,36 @@ def _unalias(c: Column) -> Column:
 
 def resolve_agg(c: Column, schema) -> "AggFunctionLike":
     c = _unalias(c)
-    assert c.node[0] == "agg", f"not an aggregate: {c.node[0]}"
+    assert c.node[0] in ("agg", "aggd"), f"not an aggregate: {c.node[0]}"
+    distinct = c.node[0] == "aggd"
     kind = c.node[1]
     child_col = c.node[2]
     child = None if child_col is None else resolve(child_col, schema)
+    if distinct and kind in ("first", "last"):
+        raise L.ResolutionError(f"{kind}(DISTINCT) is not meaningful")
     if kind == "count":
-        return CountStar(None) if child is None else Count(child)
-    if kind == "sum":
-        return Sum(child)
-    if kind == "min":
-        return Min(child)
-    if kind == "max":
-        return Max(child)
-    if kind == "avg":
-        return Average(child)
-    if kind == "first":
-        return First(child, c.node[3] if len(c.node) > 3 else True)
-    if kind == "last":
-        return Last(child, c.node[3] if len(c.node) > 3 else True)
-    raise L.ResolutionError(f"unknown aggregate {kind!r}")
+        fn = CountStar(None) if child is None else Count(child)
+    elif kind == "sum":
+        fn = Sum(child)
+    elif kind == "min":
+        fn = Min(child)
+    elif kind == "max":
+        fn = Max(child)
+    elif kind == "avg":
+        fn = Average(child)
+    elif kind == "first":
+        fn = First(child, c.node[3] if len(c.node) > 3 else True)
+    elif kind == "last":
+        fn = Last(child, c.node[3] if len(c.node) > 3 else True)
+    else:
+        raise L.ResolutionError(f"unknown aggregate {kind!r}")
+    # min/max(DISTINCT) == min/max: drop the flag so no rewrite happens.
+    fn.is_distinct = distinct and kind not in ("min", "max")
+    if fn.is_distinct:
+        # Structural key of the (unresolved) input expression, for the
+        # single-distinct-input restriction check.
+        fn.distinct_key = L.canonical_node(child_col)
+    return fn
 
 
 AggFunctionLike = object
@@ -464,8 +477,12 @@ class Planner:
         child = self._bridge(child, cdev, want_dev)
         schema = plan.child.schema
         group_by = [(n, resolve(c, schema)) for n, c in plan.group_by]
-        aggs = [AggSpec(n, resolve_agg(c, schema))
-                for n, c in plan.aggregates]
+        aggs = [AggSpec(n, fn, distinct=getattr(fn, "is_distinct", False))
+                for n, fn in ((n, resolve_agg(c, schema))
+                              for n, c in plan.aggregates)]
+        if any(s.distinct for s in aggs):
+            return self._convert_distinct_aggregate(
+                group_by, aggs, child, want_dev)
         # Two-stage: partial -> exchange on group keys -> final
         # (aggregate.scala partial/final mode pair across the shuffle).
         partial = HashAggregateExec(child, group_by, aggs, mode="partial")
@@ -481,6 +498,63 @@ class Planner:
             (n, BoundReference(i, e.data_type()))
             for i, (n, e) in enumerate(group_by)]
         final = HashAggregateExec(ex, final_groups, aggs, mode="final")
+        return final, want_dev
+
+    def _convert_distinct_aggregate(self, group_by, aggs, child,
+                                    want_dev: bool) -> Tuple[Exec, bool]:
+        """DISTINCT aggregates via the reference's partial-merge mode
+        combos (aggregate.scala:305 distinct handling):
+
+          partial  group by (keys..., x) w/ partial non-distinct aggs
+          -> hash exchange on keys (x rides along; co-location by keys
+             suffices since dedup completes in the merge stage)
+          -> merge  group by (keys..., x): dedup complete, buffers merged
+          -> mixed_final group by keys: distinct aggs UPDATE over the
+             now-unique x values, non-distinct aggs MERGE their buffers
+
+        All distinct aggregates must share one input expression (Spark's
+        planner has the same single-distinct-column restriction before
+        falling back to expand-based rewrites)."""
+        d_specs = [s for s in aggs if s.distinct]
+        nd_specs = [s for s in aggs if not s.distinct]
+        x_exprs = {s.fn.distinct_key for s in d_specs}
+        if len(x_exprs) > 1:
+            raise L.ResolutionError(
+                "multiple DISTINCT aggregates must share the same input "
+                f"expression; got {len(x_exprs)} different ones")
+        x = d_specs[0].fn.child
+        xt = x.data_type()
+        nkeys = len(group_by)
+        # Stage A: partial, keyed by (keys..., x).
+        gb_a = list(group_by) + [("__distinct_x", x)]
+        stage_a = HashAggregateExec(child, gb_a, nd_specs, mode="partial")
+        # Exchange on the group keys only (zero keys -> single partition).
+        if nkeys:
+            keys = [BoundReference(i, e.data_type())
+                    for i, (_, e) in enumerate(group_by)]
+            ex = self._hash_exchange(stage_a, keys,
+                                     self._shuffle_partitions())
+        else:
+            ex = ShuffleExchangeExec(stage_a, SinglePartitioning())
+        # Stage B: merge, still keyed by (keys..., x) over the buffer
+        # layout [keys..., x, nd buffers...].
+        gb_b = [(n, BoundReference(i, e.data_type()))
+                for i, (n, e) in enumerate(group_by)]
+        gb_b.append(("__distinct_x", BoundReference(nkeys, xt)))
+        stage_b = HashAggregateExec(ex, gb_b, nd_specs, mode="merge")
+        # Stage C: mixed final keyed by keys; distinct fns read x at
+        # ordinal nkeys of stage B's output.
+        final_groups = [(n, BoundReference(i, e.data_type()))
+                        for i, (n, e) in enumerate(group_by)]
+        specs_c = []
+        for s in aggs:
+            if s.distinct:
+                fn = type(s.fn)(BoundReference(nkeys, xt))
+                specs_c.append(AggSpec(s.name, fn, distinct=True))
+            else:
+                specs_c.append(s)
+        final = HashAggregateExec(stage_b, final_groups, specs_c,
+                                  mode="mixed_final")
         return final, want_dev
 
     def _convert_join(self, plan: L.LogicalJoin, meta: NodeMeta, kids,

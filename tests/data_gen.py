@@ -1,0 +1,199 @@
+"""Typed fuzzer generators (ref: integration_tests/src/main/python/
+data_gen.py:26-491).
+
+Seeded per-type generators with adversarial special-value injection —
+NaN/±0.0/±inf for floats, min/max for integrals, empty/long/multibyte for
+strings, nulls everywhere — plus frame builders (`gen_df`, `unary_op_df`,
+`binary_op_df`) feeding the dual-engine compare harness. The point
+(mirrors the reference): the CPU-vs-device equality harness only finds
+corner-case bugs if the data contains the corners.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+
+
+class DataGen:
+    """One column's generator. ``special`` values are injected with
+    probability ``special_prob`` each row; ``nullable`` injects None."""
+
+    dtype: dt.DataType
+    special: Sequence = ()
+
+    def __init__(self, nullable: bool = True, special_prob: float = 0.15,
+                 null_prob: float = 0.12):
+        self.nullable = nullable
+        self.special_prob = special_prob
+        self.null_prob = null_prob
+
+    def _base(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def gen(self, rng: np.random.Generator, n: int) -> list:
+        out = []
+        for _ in range(n):
+            r = rng.random()
+            if self.nullable and r < self.null_prob:
+                out.append(None)
+            elif self.special and r < self.null_prob + self.special_prob:
+                out.append(self.special[int(rng.integers(
+                    len(self.special)))])
+            else:
+                out.append(self._base(rng))
+        return out
+
+
+class BooleanGen(DataGen):
+    dtype = dt.BOOL
+
+    def _base(self, rng):
+        return bool(rng.integers(2))
+
+
+class _IntGen(DataGen):
+    lo: int
+    hi: int
+
+    @property
+    def special(self):
+        return (self.lo, self.hi, 0, -1, 1)
+
+    def _base(self, rng):
+        return int(rng.integers(self.lo, self.hi, endpoint=True))
+
+
+class ByteGen(_IntGen):
+    dtype = dt.INT8
+    lo, hi = -128, 127
+
+
+class ShortGen(_IntGen):
+    dtype = dt.INT16
+    lo, hi = -(2 ** 15), 2 ** 15 - 1
+
+
+class IntegerGen(_IntGen):
+    dtype = dt.INT32
+    lo, hi = -(2 ** 31), 2 ** 31 - 1
+
+
+class LongGen(_IntGen):
+    dtype = dt.INT64
+    lo, hi = -(2 ** 63), 2 ** 63 - 1
+
+
+class FloatGen(DataGen):
+    dtype = dt.FLOAT32
+    special = (float("nan"), float("inf"), float("-inf"), 0.0, -0.0,
+               1.0, -1.0, 3.4028235e38, -3.4028235e38, 1.17549435e-38)
+
+    def _base(self, rng):
+        return float(np.float32(rng.normal(0, 1e6)))
+
+
+class DoubleGen(DataGen):
+    dtype = dt.FLOAT64
+    # No subnormals (5e-324): XLA flushes them to zero (FTZ) while numpy
+    # keeps them — a known accelerator divergence, same class of corner
+    # the reference gates rather than fixes.
+    special = (float("nan"), float("inf"), float("-inf"), 0.0, -0.0,
+               1.0, -1.0, 1.7976931348623157e308)
+
+    def _base(self, rng):
+        return float(rng.normal(0, 1e12))
+
+
+class StringGen(DataGen):
+    dtype = dt.STRING
+    special = ("", " ", "  leading", "trailing  ", "héllo wörld",
+               "\t\n", "a" * 60, "%percent%", "_under_")
+
+    _ALPHA = "abcdefghijklmnopqrstuvwxyzABCXYZ0123456789 ,.;-"
+
+    def _base(self, rng):
+        n = int(rng.integers(0, 12))
+        return "".join(self._ALPHA[int(rng.integers(len(self._ALPHA)))]
+                       for _ in range(n))
+
+
+class DateGen(DataGen):
+    dtype = dt.DATE
+    # Days since epoch: cover pre-epoch, leap years, far future.
+    special = (0, -1, -719162, 2932896, 18321, 10957)
+
+    def _base(self, rng):
+        return int(rng.integers(-30000, 30000))
+
+
+class TimestampGen(DataGen):
+    dtype = dt.TIMESTAMP
+    special = (0, -1, 1, 86399999999, -62135596800000000)
+
+    def _base(self, rng):
+        return int(rng.integers(-2 ** 44, 2 ** 44))
+
+
+class RepeatSeqGen(DataGen):
+    """Cycles a small pool of values — makes join/groupby keys collide
+    (data_gen.py RepeatSeqGen)."""
+
+    def __init__(self, inner: DataGen, length: int = 8, seed: int = 7,
+                 **kw):
+        super().__init__(nullable=inner.nullable, **kw)
+        self.dtype = inner.dtype
+        rng = np.random.default_rng(seed)
+        self.pool = [inner._base(rng) for _ in range(length)]
+        if inner.nullable:
+            self.pool[0] = None
+        self._i = 0
+
+    def gen(self, rng, n):
+        out = []
+        for _ in range(n):
+            out.append(self.pool[self._i % len(self.pool)])
+            self._i += 1
+        return out
+
+
+ALL_GENS: List[DataGen] = [
+    BooleanGen(), ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+    FloatGen(), DoubleGen(), StringGen(), DateGen(), TimestampGen(),
+]
+
+NUMERIC_GENS = [ByteGen(), ShortGen(), IntegerGen(), LongGen(),
+                FloatGen(), DoubleGen()]
+INTEGRAL_GENS = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+FLOAT_GENS = [FloatGen(), DoubleGen()]
+ORDERABLE_GENS = ALL_GENS
+
+
+def gen_batch(gens: Sequence[Tuple[str, DataGen]], n: int,
+              seed: int = 0) -> HostBatch:
+    """data_gen.py gen_df analog -> HostBatch."""
+    rng = np.random.default_rng(seed)
+    schema = [(name, g.dtype) for name, g in gens]
+    data = {name: g.gen(rng, n) for name, g in gens}
+    return HostBatch.from_pydict(schema, data)
+
+
+def unary_op_batch(gen: DataGen, n: int = 64, seed: int = 0) -> HostBatch:
+    return gen_batch([("a", gen)], n, seed)
+
+
+def binary_op_batch(gen_a: DataGen, gen_b: Optional[DataGen] = None,
+                    n: int = 64, seed: int = 0) -> HostBatch:
+    return gen_batch([("a", gen_a), ("b", gen_b or gen_a)], n, seed)
+
+
+def gen_dict(gens: Sequence[Tuple[str, DataGen]], n: int, seed: int = 0):
+    """Schema + python-dict form for TpuSession.create_dataframe."""
+    rng = np.random.default_rng(seed)
+    schema = [(name, g.dtype) for name, g in gens]
+    data = {name: g.gen(rng, n) for name, g in gens}
+    return schema, data

@@ -124,6 +124,95 @@ class TestDataFrameBasics:
              (3, 30, 3, 300), (None, None, 4, 400)], key=str)
 
 
+class TestDistinctAggregates:
+    """DISTINCT aggregates via the partial-merge mode combos
+    (aggregate.scala:305 distinct handling)."""
+
+    def test_count_distinct_grouped(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=3)
+        out = dual_collect(df.group_by("k").agg(
+            agg_count_distinct(col("v")).alias("dv")))
+        asmap = dict(out)
+        # a: v = 1,3,6 -> 3 distinct; None: 4,8 -> 2; b: 2,None -> 1
+        assert asmap["a"] == 3 and asmap[None] == 2 and asmap["b"] == 1
+
+    def test_count_distinct_with_duplicates(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct, agg_sum_distinct
+        data = {"k": ["a", "a", "a", "b", "b", "b", "b"],
+                "v": [1, 1, 2, 5, 5, 5, None]}
+        schema = [("k", dt.STRING), ("v", dt.INT32)]
+        df = session.create_dataframe(data, schema, num_partitions=2)
+        out = dual_collect(df.group_by("k").agg(
+            agg_count_distinct(col("v")).alias("dc"),
+            agg_sum_distinct(col("v")).alias("ds")))
+        asmap = {r[0]: r[1:] for r in out}
+        assert asmap["a"] == (2, 3)     # {1,2}
+        assert asmap["b"] == (1, 5)     # {5}
+
+    def test_distinct_mixed_with_plain_aggs(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = dual_collect(df.group_by("k").agg(
+            agg_count().alias("n"),
+            agg_count_distinct(col("v")).alias("dv"),
+            agg_sum(col("v")).alias("sv")))
+        asmap = {r[0]: r[1:] for r in out}
+        assert asmap["a"] == (3, 3, 10)
+        assert asmap["b"] == (2, 1, 2)
+        assert asmap[None] == (2, 2, 12)
+
+    def test_global_count_distinct(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct
+        data = {"v": [3, 1, 3, None, 1, 3]}
+        df = session.create_dataframe(data, [("v", dt.INT32)],
+                                      num_partitions=3)
+        out = dual_collect(df.agg(agg_count_distinct(col("v")).alias("d")))
+        assert out == [(2,)]
+
+    def test_avg_distinct(self, session):
+        from spark_rapids_tpu.api import agg_avg_distinct
+        data = {"k": ["a", "a", "a", "b"], "x": [2.0, 2.0, 4.0, 10.0]}
+        schema = [("k", dt.STRING), ("x", dt.FLOAT64)]
+        df = session.create_dataframe(data, schema, num_partitions=2)
+        out = dual_collect(df.group_by("k").agg(
+            agg_avg_distinct(col("x")).alias("ax")), approx_float=True)
+        asmap = dict(out)
+        assert asmap["a"] == 3.0 and asmap["b"] == 10.0
+
+    def test_multiple_distinct_same_input_ok(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct, agg_sum_distinct
+        df = session.create_dataframe(DATA, SCHEMA)
+        out = dual_collect(df.group_by("k").agg(
+            agg_count_distinct(col("v")).alias("c"),
+            agg_sum_distinct(col("v")).alias("s")))
+        asmap = {r[0]: r[1:] for r in out}
+        assert asmap["a"] == (3, 10)
+
+    def test_multiple_distinct_different_inputs_rejected(self, session):
+        from spark_rapids_tpu.api import agg_count_distinct
+        from spark_rapids_tpu.plan.logical import ResolutionError
+        df = session.create_dataframe(DATA, SCHEMA)
+        q = df.group_by("k").agg(
+            agg_count_distinct(col("v")).alias("a"),
+            agg_count_distinct(col("x")).alias("b"))
+        with pytest.raises(ResolutionError):
+            q.collect()
+
+    def test_distinct_input_check_sees_constructor_args(self, session):
+        # round(v, 1) vs round(v, 2) must be rejected even though both
+        # pretty-print as Round(v) — the structural key keeps the scale.
+        from spark_rapids_tpu.api import (agg_count_distinct,
+                                          agg_sum_distinct, round_col)
+        from spark_rapids_tpu.plan.logical import ResolutionError
+        df = session.create_dataframe(DATA, SCHEMA)
+        q = df.group_by("k").agg(
+            agg_sum_distinct(round_col(col("x"), 1)).alias("a"),
+            agg_count_distinct(round_col(col("x"), 2)).alias("b"))
+        with pytest.raises(ResolutionError):
+            q.collect()
+
+
 class TestPlanRewrite:
     def test_exec_kill_switch_falls_back(self):
         s = TpuSession({"spark.rapids.sql.exec.LogicalFilter": False})

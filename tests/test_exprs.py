@@ -562,3 +562,110 @@ class TestReviewRegressions:
         hc = HostColumn(dt.STRING, data, np.array([True, False]))
         hb = HostBatch(("s",), [hc])
         check_expr(E.Upper(Ref(0, dt.STRING)), hb, ["OK", None])
+
+
+class TestNewStringExprs:
+    """Round-3 expression breadth (GpuOverrides.scala:537-1667 surface)."""
+
+    def test_concat_ws_skips_nulls(self):
+        b = make_batch([("s", dt.STRING), ("t", dt.STRING)],
+                       {"s": ["a", None, "c", None],
+                        "t": ["x", "y", None, None]})
+        check_expr(E.ConcatWs("-", Ref(0, dt.STRING), Ref(1, dt.STRING)),
+                   b, ["a-x", "y", "c", ""])
+
+    def test_concat_ws_multi(self):
+        b = make_batch(
+            [("a", dt.STRING), ("b", dt.STRING), ("c", dt.STRING)],
+            {"a": ["1", "1", None], "b": [None, "2", None],
+             "c": ["3", "3", None]})
+        check_expr(E.ConcatWs(", ", Ref(0, dt.STRING), Ref(1, dt.STRING),
+                              Ref(2, dt.STRING)),
+                   b, ["1, 3", "1, 2, 3", ""])
+
+    def test_repeat(self):
+        b = make_batch([("s", dt.STRING)], {"s": ["ab", "", None, "x"]})
+        check_expr(E.StringRepeat(Ref(0, dt.STRING), 3), b,
+                   ["ababab", "", None, "xxx"])
+
+    def test_reverse_utf8(self):
+        b = make_batch([("s", dt.STRING)],
+                       {"s": ["abc", "", None, "héllo", "abé"]})
+        check_expr(E.StringReverse(Ref(0, dt.STRING)), b,
+                   ["cba", "", None, "olléh", "éba"])
+
+    def test_initcap(self):
+        b = make_batch([("s", dt.STRING)],
+                       {"s": ["hello world", "fOO bAR", "", None, "a b c"]})
+        check_expr(E.InitCap(Ref(0, dt.STRING)), b,
+                   ["Hello World", "Foo Bar", "", None, "A B C"])
+
+    def test_regexp_extract(self):
+        b = make_batch([("s", dt.STRING)],
+                       {"s": ["100-200", "foo", None, "7-8"]})
+        check_expr(E.RegExpExtract(Ref(0, dt.STRING), r"(\d+)-(\d+)", 1),
+                   b, ["100", "", None, "7"])
+        check_expr(E.RegExpExtract(Ref(0, dt.STRING), r"(\d+)-(\d+)", 2),
+                   b, ["200", "", None, "8"])
+
+    def test_translate(self):
+        b = make_batch([("s", dt.STRING)], {"s": ["abcba", None, "xyz"]})
+        check_expr(E.Translate(Ref(0, dt.STRING), "abx", "AB"), b,
+                   ["ABcBA", None, "yz"])
+
+    def test_lpad_rpad(self):
+        b = make_batch([("s", dt.STRING)], {"s": ["hi", "longer", None]})
+        check_expr(E.StringLPad(Ref(0, dt.STRING), 5, "*"), b,
+                   ["***hi", "longe", None])
+        check_expr(E.StringRPad(Ref(0, dt.STRING), 5, "*"), b,
+                   ["hi***", "longe", None])
+
+    def test_lpad_nonpositive_length_is_empty(self):
+        # Spark: lpad/rpad with len <= 0 returns '' (not a tail slice).
+        b = make_batch([("s", dt.STRING)], {"s": ["hello", "", None]})
+        check_expr(E.StringLPad(Ref(0, dt.STRING), -1, "*"), b,
+                   ["", "", None])
+        check_expr(E.StringRPad(Ref(0, dt.STRING), 0, "*"), b,
+                   ["", "", None])
+
+    def test_concat_ws_no_columns(self):
+        b = make_batch([("s", dt.STRING)], {"s": ["a", "b"]})
+        check_expr(E.ConcatWs("-"), b, ["", ""])
+
+
+class TestBRound:
+    def test_bround_half_even_float(self):
+        b = make_batch([("x", dt.FLOAT64)],
+                       {"x": [2.5, 3.5, -2.5, 1.25, None]})
+        check_expr(E.BRound(Ref(0, dt.FLOAT64), 0), b,
+                   [2.0, 4.0, -2.0, 1.0, None])
+        check_expr(E.BRound(Ref(0, dt.FLOAT64), 1), b,
+                   [2.5, 3.5, -2.5, 1.2, None], approx_float=True)
+
+    def test_bround_int_negative_scale(self):
+        b = make_batch([("x", dt.INT64)],
+                       {"x": [25, 35, -25, -35, 24, 26, None]})
+        check_expr(E.BRound(Ref(0, dt.INT64), -1), b,
+                   [20, 40, -20, -40, 20, 30, None])
+
+
+class TestTruncDate:
+    def test_trunc_year_month_quarter_week(self):
+        import datetime as pydt
+        epoch = pydt.date(1970, 1, 1)
+        days = lambda y, m, d: (pydt.date(y, m, d) - epoch).days
+        b = make_batch([("d", dt.DATE)],
+                       {"d": [days(2020, 7, 17), days(2019, 2, 28), None]})
+        check_expr(E.TruncDate(Ref(0, dt.DATE), "year"), b,
+                   [days(2020, 1, 1), days(2019, 1, 1), None])
+        check_expr(E.TruncDate(Ref(0, dt.DATE), "month"), b,
+                   [days(2020, 7, 1), days(2019, 2, 1), None])
+        check_expr(E.TruncDate(Ref(0, dt.DATE), "quarter"), b,
+                   [days(2020, 7, 1), days(2019, 1, 1), None])
+        # 2020-07-17 is a Friday -> Monday 2020-07-13.
+        check_expr(E.TruncDate(Ref(0, dt.DATE), "week"), b,
+                   [days(2020, 7, 13), days(2019, 2, 25), None])
+
+    def test_trunc_bad_format_is_null(self):
+        b = make_batch([("d", dt.DATE)], {"d": [1000, None]})
+        check_expr(E.TruncDate(Ref(0, dt.DATE), "bogus"), b, [None, None])

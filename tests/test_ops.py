@@ -144,6 +144,22 @@ class TestSort:
         assert xs[5] == 8.0
         assert math.isnan(xs[6]) and xs[7] is None
 
+    def test_host_sort_negative_nan_greatest(self):
+        # Sign-bit NaN must sort greatest on the host oracle too, matching
+        # the device kernel's nan_word handling (Java Double.compare).
+        import struct as _struct
+        from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+        from spark_rapids_tpu.ops.sort import sort_host_batch
+        neg_nan = _struct.unpack("<d", _struct.pack("<Q",
+                                                    0xFFF8000000000000))[0]
+        vals = np.array([neg_nan, 1.0, -2.0, float("inf")], np.float64)
+        hb = HostBatch(("x",), [HostColumn(dt.FLOAT64, vals,
+                                           np.ones(4, np.bool_))])
+        out = sort_host_batch(hb, [SortOrder(Ref(0, dt.FLOAT64))])
+        xs = out.columns[0].data
+        assert list(xs[:3]) == [-2.0, 1.0, float("inf")]
+        assert math.isnan(xs[3])
+
     def test_sort_stable_ties(self):
         schema = [("a", dt.INT32), ("b", dt.INT32)]
         data = {"a": [1, 1, 1, 0, 0], "b": [10, 20, 30, 40, 50]}
