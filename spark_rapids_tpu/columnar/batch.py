@@ -143,6 +143,11 @@ class DeviceBatch:
 
     columns: Tuple[DeviceColumn, ...]
     num_rows: jax.Array          # int32 scalar
+    # Host-known exact row count, when the producer knows it (uploads do).
+    # NOT a pytree leaf: jit-produced batches lose it (None = unknown).
+    # Lets consumers (exchange shrink, downloads) skip a device->host sync.
+    rows_hint: Optional[int] = dataclasses.field(
+        default=None, compare=False)
 
     def tree_flatten(self):
         return (tuple(self.columns), self.num_rows), None
@@ -287,6 +292,25 @@ def shrink_to_capacity(batch: DeviceBatch, capacity: int) -> DeviceBatch:
             return b.gather(idx, b.num_rows)
         fn = jax.jit(_shrink)
         _JIT_CACHE[("shrink", capacity)] = fn
+    return fn(batch)
+
+
+def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
+    """Up to ``k`` evenly spaced live rows, as a k-capacity batch — the
+    device-side half of range-bounds sampling (GpuRangePartitioner's
+    reservoir sample): sample BEFORE downloading so a bounds probe moves
+    k rows over the link instead of a whole batch."""
+    fn = _JIT_CACHE.get(("sample", k))
+    if fn is None:
+        def _sample(b: DeviceBatch) -> DeviceBatch:
+            n = jnp.maximum(b.num_rows, 1)
+            idx = (jnp.arange(k, dtype=jnp.int32)
+                   * (n - 1)) // jnp.maximum(jnp.asarray(k - 1, jnp.int32),
+                                             1)
+            take = jnp.minimum(jnp.asarray(k, jnp.int32), b.num_rows)
+            return b.gather(idx, take)
+        fn = jax.jit(_sample)
+        _JIT_CACHE[("sample", k)] = fn
     return fn(batch)
 
 

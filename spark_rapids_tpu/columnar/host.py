@@ -211,74 +211,84 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
                    string_widths: Optional[dict] = None) -> DeviceBatch:
     """Upload a host batch into a fresh fixed-capacity device batch.
 
-    Ref: HostColumnarToGpu.scala / GpuRowToColumnarExec.scala — here the
-    "builders" are numpy padding + one jnp.asarray per buffer so the upload
-    is a handful of contiguous H2D copies.
+    Ref: HostColumnarToGpu.scala / GpuRowToColumnarExec.scala. The upload
+    goes through the wire codec (columnar/wire.py): narrow lossless wire
+    dtypes + packed/absent validity, one device_put, and a jitted
+    on-device widen back to the logical layout — the transfer link, not
+    HBM, is the scarce resource.
     """
-    n = batch.num_rows
-    cap = capacity if capacity is not None else bucket_capacity(n)
-    assert cap >= n, f"capacity {cap} < rows {n}"
-    cols = []
-    for name, hc in zip(batch.names, batch.columns):
-        validity = np.zeros(cap, dtype=np.bool_)
-        validity[:n] = hc.validity
-        if hc.dtype.is_string:
-            m, lens = strings_to_matrix(hc)
-            lens = np.where(hc.validity, lens, 0)
-            want = dt.string_width_bucket(int(lens.max()) if n else 0)
-            if string_widths and name in string_widths:
-                want = max(want, string_widths[name])
-            data = np.zeros((cap, want), dtype=np.uint8)
-            w = min(want, m.shape[1])
-            data[:n, :w] = np.where(hc.validity[:, None], m, 0)[:, :w]
-            lengths = np.zeros(cap, dtype=np.int32)
-            lengths[:n] = lens
-            cols.append(DeviceColumn(hc.dtype, jnp.asarray(data),
-                                     jnp.asarray(validity),
-                                     jnp.asarray(lengths)))
+    from spark_rapids_tpu.columnar import wire
+    return wire.upload(batch, capacity, string_widths)
+
+
+# Batches whose device footprint exceeds this get shrunk to their live-row
+# bucket before download: the row-count sync (~1 round trip) buys back the
+# padding bytes, which dominate on a slow link.
+_SHRINK_DOWNLOAD_BYTES = 1 << 22
+
+
+def download_batches(batches: Sequence[DeviceBatch],
+                     names: Optional[Sequence[str]] = None
+                     ) -> List[HostBatch]:
+    """Download many device batches with the minimum number of round trips.
+
+    Ref: GpuColumnarToRowExec.scala — the single place results leave HBM.
+    Two-phase on purpose (SURVEY §7 sizes-then-data): phase 1 pulls every
+    unknown row count in ONE ``jax.device_get`` and shrinks padded batches
+    to their live bucket; phase 2 fetches all remaining buffers in ONE
+    more ``device_get`` so the transfers pipeline. On a tunneled device
+    each extra sync is a full network round trip, so per-batch/per-buffer
+    loops cost O(batches*columns) round trips while this costs two.
+    """
+    import jax
+    from spark_rapids_tpu.columnar.batch import shrink_to_capacity
+    batches = list(batches)
+    counts: List[Optional[int]] = [b.rows_hint for b in batches]
+    unknown = [i for i, b in enumerate(batches)
+               if counts[i] is None
+               and b.device_size_bytes() > _SHRINK_DOWNLOAD_BYTES]
+    if unknown:
+        pulled = jax.device_get([batches[i].num_rows for i in unknown])
+        for i, n in zip(unknown, pulled):
+            counts[i] = int(n)
+    for i, n in enumerate(counts):
+        if n is not None:
+            batches[i] = shrink_to_capacity(
+                batches[i], bucket_capacity(max(n, 1)))
+    leaves: List = []
+    for b in batches:
+        leaves.append(b.num_rows)
+        for c in b.columns:
+            leaves.append(c.data)
+            leaves.append(c.validity)
+            if c.dtype.is_string:
+                leaves.append(c.lengths)
+    fetched = jax.device_get(leaves)
+    it = iter(fetched)
+    out = []
+    for b in batches:
+        n = int(next(it))
+        cols = []
+        for c in b.columns:
+            data_h = next(it)
+            validity = np.asarray(next(it))[:n]
+            if c.dtype.is_string:
+                lengths = np.asarray(next(it))[:n]
+                cols.append(matrix_to_strings(np.asarray(data_h)[:n],
+                                              lengths, validity))
+            else:
+                data = np.asarray(data_h)[:n].copy()
+                data[~validity] = np.zeros(1, c.dtype.np_dtype)
+                cols.append(HostColumn(c.dtype, data, validity))
+        if names is None:
+            batch_names = tuple(f"c{i}" for i in range(b.num_columns))
         else:
-            data = np.zeros(cap, dtype=hc.dtype.np_dtype)
-            data[:n] = np.where(hc.validity, hc.data,
-                                np.zeros(1, hc.dtype.np_dtype))
-            cols.append(DeviceColumn(hc.dtype, jnp.asarray(data),
-                                     jnp.asarray(validity)))
-    return DeviceBatch(tuple(cols), jnp.asarray(n, jnp.int32))
+            batch_names = tuple(names)
+        out.append(HostBatch(batch_names, cols))
+    return out
 
 
 def device_to_host(batch: DeviceBatch,
                    names: Optional[Sequence[str]] = None) -> HostBatch:
-    """Download a device batch, trimming padding rows.
-
-    Ref: GpuColumnarToRowExec.scala — the single place results leave HBM.
-
-    All buffers (row count + every column's data/validity/lengths) are
-    fetched in ONE ``jax.device_get`` so the transfers run concurrently:
-    on a remote/tunneled device each sequential D2H is a full network
-    round trip (~200ms), so per-buffer ``np.asarray`` loops cost
-    O(columns) round trips while this costs one.
-    """
-    import jax
-    leaves: List = [batch.num_rows]
-    for c in batch.columns:
-        leaves.append(c.data)
-        leaves.append(c.validity)
-        if c.dtype.is_string:
-            leaves.append(c.lengths)
-    fetched = jax.device_get(leaves)
-    n = int(fetched[0])
-    it = iter(fetched[1:])
-    cols = []
-    for c in batch.columns:
-        data_h = next(it)
-        validity = np.asarray(next(it))[:n]
-        if c.dtype.is_string:
-            lengths = np.asarray(next(it))[:n]
-            cols.append(matrix_to_strings(np.asarray(data_h)[:n],
-                                          lengths, validity))
-        else:
-            data = np.asarray(data_h)[:n].copy()
-            data[~validity] = np.zeros(1, c.dtype.np_dtype)
-            cols.append(HostColumn(c.dtype, data, validity))
-    if names is None:
-        names = tuple(f"c{i}" for i in range(batch.num_columns))
-    return HostBatch(tuple(names), cols)
+    """Download one device batch, trimming padding rows."""
+    return download_batches([batch], names)[0]

@@ -1,0 +1,232 @@
+"""Wire codec for host->device uploads: narrow dtypes + packed validity.
+
+TPU-first re-design of the reference's GPU parquet decode
+(GpuParquetScan.scala:1144 keeps *compressed pages* on the transfer path and
+decodes on-device with cuDF). XLA has no byte-oriented snappy kernel, but the
+same bandwidth win comes from a typed transform: before upload each column is
+analyzed (vectorized numpy, one pass) and, when lossless, re-encoded to a
+narrower wire type --
+
+- integers whose [min, max] fits int8/int16/int32 ship narrow;
+- float64 columns that are exactly a scaled decimal (prices with 2dp, whole
+  quantities: ``rint(v * scale) / scale == v`` bitwise) ship as scaled ints;
+- float64 exactly representable as float32 ships as float32;
+- all-valid validity vanishes (reconstructed from the row mask); otherwise
+  it ships as packed bits (1/8th);
+- string length columns ship int16 (width <= 32k by construction).
+
+The device side widens back to the logical dtype inside ONE jitted decode
+program per (capacity, spec) -- a few fused casts, so HBM traffic is the
+only cost there. The transfer link (PCIe / a tunneled remote device) is the
+scarce resource this trades against; reconstruction is bit-exact by
+construction, so every engine invariant (zeroed padding, validity masking)
+is preserved.
+
+All buffers of a batch go up in a single ``jax.device_put`` call so the
+transfers pipeline instead of paying one round trip per buffer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+
+# Column wire spec (static, hashable -- part of the decode jit cache key):
+#   numeric: ("num", logical_name, wire_np_name, scale, vmode)
+#   string:  ("str", width, vmode)
+# vmode: "all" (validity == row mask) | "packed" (bit-packed uint8).
+
+_INT_CANDIDATES = (
+    (np.int8, -128, 127),
+    (np.int16, -32768, 32767),
+    (np.int32, -(2 ** 31), 2 ** 31 - 1),
+)
+
+# Decimal scales tried for exact float64 re-encoding, cheapest-win first:
+# whole numbers, then money (2dp), then 1dp.
+_FLOAT_SCALES = (1, 100, 10)
+
+
+def _narrow_int(values: np.ndarray, itemsize: int):
+    """Smallest int dtype whose range covers values (None = keep)."""
+    if values.size == 0:
+        return np.int8
+    mn = values.min()
+    mx = values.max()
+    for cand, lo, hi in _INT_CANDIDATES:
+        if np.dtype(cand).itemsize >= itemsize:
+            return None
+        if lo <= mn and mx <= hi:
+            return cand
+    return None
+
+
+def _encode_float64(values: np.ndarray):
+    """Returns (wire_array, wire_np_name, scale) or None. Lossless only:
+    decode(encode(v)) must equal v bitwise -- NaN/inf/-0.0 all disqualify
+    the scaled path (and -0.0 would silently become +0.0)."""
+    if values.size and not np.isfinite(values).all():
+        return None
+    if values.size and np.any((values == 0) & np.signbit(values)):
+        return None
+    for scale in _FLOAT_SCALES:
+        w = values * scale
+        r = np.rint(w)
+        if np.any(np.abs(r) > 2 ** 31 - 1):
+            continue
+        if not np.array_equal(r / scale, values):
+            continue
+        narrow = _narrow_int(r, 8) or np.int32
+        return r.astype(narrow), np.dtype(narrow).name, scale
+    f32 = values.astype(np.float32)
+    if np.array_equal(f32.astype(np.float64), values):
+        return f32, "float32", 0
+    return None
+
+
+def encode_column(hc, name: str, n: int, cap: int,
+                  string_widths: Optional[dict]) -> Tuple[List[np.ndarray],
+                                                          tuple]:
+    """Host-side encode of one column -> (wire arrays, static spec)."""
+    from spark_rapids_tpu.columnar.host import strings_to_matrix
+    validity = np.zeros(cap, dtype=np.bool_)
+    validity[:n] = hc.validity
+    all_valid = bool(validity[:n].all())
+    if all_valid:
+        vmode, varrs = "all", []
+    else:
+        vmode = "packed"
+        varrs = [np.packbits(validity, bitorder="little")]
+
+    if hc.dtype.is_string:
+        m, lens = strings_to_matrix(hc)
+        lens = np.where(hc.validity, lens, 0)
+        want = dt.string_width_bucket(int(lens.max()) if n else 0)
+        if string_widths and name in string_widths:
+            want = max(want, string_widths[name])
+        data = np.zeros((cap, want), dtype=np.uint8)
+        w = min(want, m.shape[1])
+        data[:n, :w] = np.where(hc.validity[:, None], m, 0)[:, :w]
+        lengths = np.zeros(cap, dtype=np.int16)
+        lengths[:n] = lens
+        return [data, lengths] + varrs, ("str", want, vmode)
+
+    values = np.where(hc.validity, hc.data,
+                      np.zeros(1, hc.dtype.np_dtype)) \
+        .astype(hc.dtype.np_dtype, copy=False)
+    wire = values
+    wire_name = hc.dtype.np_dtype.name
+    scale = 0
+    if hc.dtype.np_dtype == np.float64:
+        enc = _encode_float64(values)
+        if enc is not None:
+            wire, wire_name, scale = enc
+    elif hc.dtype.np_dtype.kind == "i":
+        narrow = _narrow_int(values, hc.dtype.itemsize)
+        if narrow is not None:
+            wire = values.astype(narrow)
+            wire_name = np.dtype(narrow).name
+    data = np.zeros(cap, dtype=wire.dtype)
+    data[:n] = wire
+    # The scale ships as a RUNTIME f64 scalar: a constant denominator lets
+    # XLA strength-reduce the divide into a reciprocal multiply, which is
+    # not correctly rounded and would break the bit-exact round trip the
+    # host-side check guarantees (true IEEE division is exact here).
+    sarr = [np.asarray(float(scale), np.float64)] if scale else []
+    return [data] + sarr + varrs, ("num", hc.dtype.name, wire_name, scale,
+                                   vmode)
+
+
+_DECODE_JIT_CACHE: dict = {}
+
+
+def _unpack_validity(bits: jax.Array, cap: int) -> jax.Array:
+    """Inverse of np.packbits(bitorder='little'): (cap/8,) uint8 -> bool."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    opened = (bits[:, None] >> shifts[None, :]) & 1
+    return opened.reshape(-1)[:cap].astype(jnp.bool_)
+
+
+def _decode_fn(cap: int, specs: tuple):
+    def decode(arrays, num_rows):
+        it = iter(arrays)
+        row_mask = None
+        cols = []
+        for spec in specs:
+            if spec[0] == "str":
+                _, width, vmode = spec
+                data = next(it)
+                lengths = next(it).astype(jnp.int32)
+                if vmode == "packed":
+                    validity = _unpack_validity(next(it), cap)
+                else:
+                    if row_mask is None:
+                        row_mask = jnp.arange(cap, dtype=jnp.int32) \
+                            < num_rows
+                    validity = row_mask
+                cols.append(DeviceColumn(dt.STRING, data, validity,
+                                         lengths))
+                continue
+            _, logical_name, wire_name, scale, vmode = spec
+            logical = dt.type_named(logical_name)
+            w = next(it)
+            if scale:
+                data = w.astype(logical.np_dtype) / next(it)
+            elif w.dtype == logical.np_dtype:
+                data = w
+            else:
+                data = w.astype(logical.np_dtype)
+            if vmode == "packed":
+                validity = _unpack_validity(next(it), cap)
+            else:
+                if row_mask is None:
+                    row_mask = jnp.arange(cap, dtype=jnp.int32) < num_rows
+                validity = row_mask
+            cols.append(DeviceColumn(logical, data, validity))
+        return DeviceBatch(tuple(cols), num_rows)
+    return decode
+
+
+def encode_batch(batch, capacity: Optional[int] = None,
+                 string_widths: Optional[dict] = None):
+    """Host-side half of the upload: analyze + narrow + pad. CPU-only, so
+    scan prefetch threads can run it concurrently with device work.
+    Returns (arrays, specs, n, cap)."""
+    from spark_rapids_tpu.columnar.batch import bucket_capacity
+    n = batch.num_rows
+    cap = capacity if capacity is not None else bucket_capacity(n)
+    assert cap >= n, f"capacity {cap} < rows {n}"
+    arrays: List[np.ndarray] = []
+    specs = []
+    for name, hc in zip(batch.names, batch.columns):
+        arrs, spec = encode_column(hc, name, n, cap, string_widths)
+        arrays.extend(arrs)
+        specs.append(spec)
+    arrays.append(np.asarray(n, np.int32))
+    return arrays, tuple(specs), n, cap
+
+
+def upload_encoded(arrays, specs, n: int, cap: int) -> DeviceBatch:
+    """Device-side half: single device_put + jitted on-device widen."""
+    put = jax.device_put(arrays)
+    dev_arrays, num_rows = put[:-1], put[-1]
+    key = (cap, specs)
+    fn = _DECODE_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(_decode_fn(cap, specs))
+        _DECODE_JIT_CACHE[key] = fn
+    out = fn(dev_arrays, num_rows)
+    out.rows_hint = n
+    return out
+
+
+def upload(batch, capacity: Optional[int] = None,
+           string_widths: Optional[dict] = None) -> DeviceBatch:
+    """Encode + single device_put + jitted on-device widen."""
+    return upload_encoded(*encode_batch(batch, capacity, string_widths))

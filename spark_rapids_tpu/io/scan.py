@@ -317,9 +317,15 @@ class FileScanExec(LeafExec):
         window = min(nthreads, len(units))
 
         def read_unit(u):
-            return list(_read_unit_batches(self.fmt, u, self.options,
-                                           rows, self._columns))
+            # Decode AND wire-encode in the worker: the upload's host half
+            # (narrowing analysis, padding, bit-packing) is CPU work that
+            # overlaps with device consumption of earlier units.
+            from spark_rapids_tpu.columnar import wire
+            return [wire.encode_batch(hb)
+                    for hb in _read_unit_batches(self.fmt, u, self.options,
+                                                 rows, self._columns)]
 
+        from spark_rapids_tpu.columnar import wire
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=window) as pool:
             inflight = []          # [(unit, future)] bounded by `window`
@@ -330,14 +336,14 @@ class FileScanExec(LeafExec):
                     break
             while inflight:
                 unit, fut = inflight.pop(0)
-                hbs = fut.result()
+                encoded = fut.result()
                 nxt = next(it, None)
                 if nxt is not None:
                     inflight.append((nxt, pool.submit(read_unit, nxt)))
                 self._publish_input_file(ctx, partition, unit.path)
-                for hb in hbs:
+                for enc in encoded:
                     with timed(m, "bufferTime"):
-                        batch = host_to_device(hb)
+                        batch = wire.upload_encoded(*enc)
                     m.add("numOutputBatches", 1)
                     yield batch
 

@@ -629,12 +629,15 @@ class HashAggregateExec(Exec):
                 yield self._empty_result()
             return
         with timed(m):
-            # One final shrink so the yielded batch (and any collect
-            # download) is at group scale, not input scale.
-            k = max(int(acc.num_rows), 1)
-            acc = shrink_to_capacity(acc, bucket_capacity(k))
             if self.mode in ("final", "complete", "mixed_final"):
                 acc = finalize(acc)
+            # No per-partition shrink sync here: the group-count read is a
+            # device->host round trip, so whoever needs live-scale batches
+            # does it batched — exchanges shrink all child partitions with
+            # one sizes pull (two-phase exchange, SURVEY §7) and collect's
+            # download_batches shrinks before fetching. Downstream device
+            # ops just run at input capacity (compute is cheap; the link
+            # is not).
         m.add("numOutputBatches", 1)
         yield acc
 

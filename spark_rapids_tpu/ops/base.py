@@ -134,15 +134,24 @@ class Exec:
     # -- helpers -------------------------------------------------------------
     def collect(self, ctx: Optional[ExecContext] = None,
                 device: bool = True) -> List[tuple]:
-        """Run all partitions and collect rows (driver collect analog)."""
+        """Run all partitions and collect rows (driver collect analog).
+
+        The device path dispatches EVERY partition before downloading
+        anything, then fetches all result batches in one two-phase
+        ``download_batches`` call — on a tunneled device that is two
+        round trips for the whole query instead of O(batches)."""
         ctx = ctx or ExecContext()
         rows: List[tuple] = []
         names = tuple(n for n, _ in self.schema)
-        for p in range(self.num_partitions(ctx)):
-            if device:
-                for b in self.execute_device(ctx, p):
-                    rows.extend(device_to_host(b, names).to_pylist())
-            else:
+        if device:
+            from spark_rapids_tpu.columnar.host import download_batches
+            batches: List[DeviceBatch] = []
+            for p in range(self.num_partitions(ctx)):
+                batches.extend(self.execute_device(ctx, p))
+            for hb in download_batches(batches, names):
+                rows.extend(hb.to_pylist())
+        else:
+            for p in range(self.num_partitions(ctx)):
                 for b in self.execute_host(ctx, p):
                     rows.extend(b.to_pylist())
         return rows

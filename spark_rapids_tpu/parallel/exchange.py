@@ -56,13 +56,18 @@ class ShuffleExchangeExec(Exec):
             return
         # Sample: pull up to 64 rows per child partition on the host engine
         # (CPU-side sampling, like the reference).
+        from spark_rapids_tpu.columnar.batch import sample_rows
         from spark_rapids_tpu.columnar.host import device_to_host
         samples: List[HostBatch] = []
         for cp in range(self.children[0].num_partitions(ctx)):
             it = (self.children[0].execute_device(ctx, cp) if device
                   else self.children[0].execute_host(ctx, cp))
             for b in it:
-                hb = device_to_host(b) if device else b
+                if device:
+                    # Sample on device; download 64 rows, not the batch.
+                    hb = device_to_host(sample_rows(b, 64))
+                else:
+                    hb = b
                 keycols = []
                 from spark_rapids_tpu.exprs.base import as_host_column
                 for o in p.orders:
@@ -112,18 +117,36 @@ class ShuffleExchangeExec(Exec):
             self._split_jit = jax.jit(split_fn) \
                 if self.partitioning.jittable else split_fn
         split = self._split_jit
+        from spark_rapids_tpu.columnar.batch import shrink_to_capacity
         from spark_rapids_tpu.memory.stores import (
             PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+        # Two-phase sizes-then-data (SURVEY §7): materialize every child
+        # batch first, pull all unknown row counts in ONE device_get, and
+        # shrink each batch to its live bucket before splitting. Partial
+        # aggregates and selective filters yield at input capacity; one
+        # batched sync here replaces a per-partition sync there, and the
+        # split + spill accounting then work at live scale.
+        child_batches: List[DeviceBatch] = []
         for cp in range(self.children[0].num_partitions(ctx)):
-            for batch in self.children[0].execute_device(ctx, cp):
-                pieces = split(batch)
-                for p, piece in enumerate(pieces):
-                    # Shuffle output is spillable (RapidsCachingWriter
-                    # inserts into the device store; shuffle spills FIRST
-                    # per SpillPriorities) — the bucket holds a handle,
-                    # not a pinned device batch.
-                    buckets[p].append(SpillableBatch(
-                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+            child_batches.extend(self.children[0].execute_device(ctx, cp))
+        counts = [b.rows_hint for b in child_batches]
+        unknown = [i for i, c in enumerate(counts) if c is None]
+        if unknown:
+            pulled = jax.device_get(
+                [child_batches[i].num_rows for i in unknown])
+            for i, c in zip(unknown, pulled):
+                counts[i] = int(c)
+        for batch, cnt in zip(child_batches, counts):
+            batch = shrink_to_capacity(batch,
+                                       bucket_capacity(max(cnt, 1)))
+            pieces = split(batch)
+            for p, piece in enumerate(pieces):
+                # Shuffle output is spillable (RapidsCachingWriter
+                # inserts into the device store; shuffle spills FIRST
+                # per SpillPriorities) — the bucket holds a handle,
+                # not a pinned device batch.
+                buckets[p].append(SpillableBatch(
+                    ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
         ctx.cache[key] = buckets
         return buckets
 
@@ -238,6 +261,19 @@ class BroadcastExchangeExec(Exec):
             batches.extend(self.children[0].execute_device(ctx, cp))
         if not batches:
             raise ValueError("broadcast of empty child needs a schema batch")
+        # One batched sizes pull, then shrink members to live scale: the
+        # broadcast build side's capacity bounds every probe-side gather
+        # downstream, so padding here multiplies into the join.
+        from spark_rapids_tpu.columnar.batch import shrink_to_capacity
+        counts = [b.rows_hint for b in batches]
+        unknown = [i for i, c in enumerate(counts) if c is None]
+        if unknown:
+            pulled = jax.device_get(
+                [batches[i].num_rows for i in unknown])
+            for i, c in zip(unknown, pulled):
+                counts[i] = int(c)
+        batches = [shrink_to_capacity(b, bucket_capacity(max(c, 1)))
+                   for b, c in zip(batches, counts)]
         total = sum(b.capacity for b in batches)
         single = batches[0] if len(batches) == 1 else \
             concat_batches(batches, bucket_capacity(total))

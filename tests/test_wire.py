@@ -1,0 +1,135 @@
+"""Wire codec (columnar/wire.py): lossless narrow-upload round trips.
+
+The codec must be invisible: host_to_device(hb) -> device_to_host must
+reproduce every value bit-exactly, for every dtype and every adversarial
+float (NaN, inf, -0.0, denormals), with and without nulls.
+"""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar import wire
+from spark_rapids_tpu.columnar.host import (HostBatch, HostColumn,
+                                            device_to_host, host_to_device)
+
+
+def roundtrip(dtype, values):
+    hb = HostBatch.from_pydict([("x", dtype)], {"x": values})
+    db = host_to_device(hb)
+    back = device_to_host(db, ("x",))
+    return back.columns[0].to_list(), db
+
+
+class TestWireRoundTrip:
+    def test_int_narrowing_small(self):
+        vals = [1, 2, None, 127, -128]
+        out, db = roundtrip(dt.INT64, vals)
+        assert out == vals
+        # Wire dtype must actually be narrow on the encode side.
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.INT64, vals), "x", 5, 8, None)
+        assert spec[2] == "int8"
+
+    def test_int_no_narrowing_when_big(self):
+        vals = [2 ** 40, -2 ** 40, None]
+        out, _ = roundtrip(dt.INT64, vals)
+        assert out == vals
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.INT64, vals), "x", 3, 8, None)
+        assert spec[2] == "int64"
+
+    def test_float_decimal_scale(self):
+        vals = [1234.56, 0.01, None, -99.99, 24.0]
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert out == vals
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 5, 8, None)
+        assert spec[2].startswith("int") and spec[3] in (10, 100)
+
+    def test_float_whole_numbers(self):
+        vals = [1.0, 50.0, None, -3.0]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 4, 8, None)
+        assert spec[3] == 1 and spec[2] == "int8"
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert out == vals
+
+    def test_float_nan_inf_falls_back(self):
+        vals = [1.5, float("nan"), float("inf"), None]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 4, 8, None)
+        assert spec[2] == "float64" and spec[3] == 0
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert out[0] == 1.5 and np.isnan(out[1]) and out[2] == float("inf")
+
+    def test_negative_zero_preserved(self):
+        vals = [-0.0, 1.0, 2.0]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+        # -0.0 disqualifies the scaled-int path (it would become +0.0).
+        assert spec[2] in ("float64", "float32")
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert np.signbit(np.float64(out[0]))
+
+    def test_float_irrational_falls_back(self):
+        vals = [np.pi, np.e, 1/3]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+        assert spec[2] == "float64"
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert out == vals
+
+    def test_f32_exact_representable(self):
+        vals = [0.5, 0.25, 1.0 + 2 ** -20]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+        assert spec[2] == "float32"
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        assert out == vals
+
+    def test_strings_with_nulls(self):
+        vals = ["hello", None, "", "wörld"]
+        out, db = roundtrip(dt.STRING, vals)
+        assert out == vals
+
+    def test_bool(self):
+        vals = [True, None, False, True]
+        out, _ = roundtrip(dt.BOOL, vals)
+        assert out == vals
+
+    def test_all_valid_validity_elided(self):
+        vals = [1, 2, 3]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.INT32, vals), "x", 3, 8, None)
+        assert spec[-1] == "all"
+        assert len(arrs) == 1     # data only, no validity buffer
+
+    def test_nulls_packed_validity(self):
+        vals = [1, None, 3]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.INT32, vals), "x", 3, 8, None)
+        assert spec[-1] == "packed"
+        assert arrs[-1].dtype == np.uint8 and arrs[-1].size == 1
+        out, db = roundtrip(dt.INT32, vals)
+        assert out == vals
+        # Padding rows must read as invalid.
+        validity = np.asarray(db.columns[0].validity)
+        assert not validity[3:].any()
+
+    def test_empty_batch(self):
+        out, _ = roundtrip(dt.FLOAT64, [])
+        assert out == []
+
+    def test_date_narrows(self):
+        vals = [8766, 9131, None, 10956]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.DATE, vals), "x", 4, 8, None)
+        assert spec[2] == "int16"
+        out, _ = roundtrip(dt.DATE, vals)
+        assert out == vals
+
+    def test_rows_hint_set(self):
+        hb = HostBatch.from_pydict([("x", dt.INT32)], {"x": [1, 2, 3]})
+        db = host_to_device(hb)
+        assert db.rows_hint == 3
