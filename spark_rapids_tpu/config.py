@@ -213,6 +213,16 @@ SHUFFLE_COMPRESSION_CODEC = conf(
     "(ref: nvcomp LZ4; TPU path keeps data in HBM so codec is host-side "
     "only when spilled.)").string("none")
 
+SCAN_CACHE_BYTES = conf(
+    "spark.rapids.sql.format.scanCache.maxBytes").doc(
+    "Device (HBM) budget for the transparent scan-unit cache: decoded "
+    "batches of recently scanned parquet/orc/csv units stay resident and "
+    "are served without re-decoding or re-crossing the host->device link "
+    "(the TPU analog of serving Spark's columnar InMemoryTableScan from "
+    "the device store, GpuTransitionOverrides.scala:339; same role as a "
+    "transparent read cache in front of cold storage). 0 disables."
+).long(4 * 1024 * 1024 * 1024)
+
 SHUFFLE_PARTITIONS = conf("spark.rapids.sql.shuffle.partitions").doc(
     "Number of shuffle output partitions for exchanges (analog of "
     "spark.sql.shuffle.partitions).").integer(8)

@@ -207,6 +207,50 @@ def _read_unit_batches(fmt: str, unit: ScanUnit, options: Dict,
         raise ValueError(fmt)
 
 
+class DeviceScanCache:
+    """Transparent device-resident cache of decoded scan units.
+
+    The TPU analog of keeping Spark's columnar cache on the accelerator
+    (InMemoryTableScanExec handling, GpuTransitionOverrides.scala:339) at
+    scan-unit granularity: a unit's decoded DeviceBatches stay in HBM,
+    keyed by file identity (path, mtime, size), unit ordinal and the
+    pruned column set, so a repeated query serves them without touching
+    the host->device link (which, on a tunneled device, costs ~100ms per
+    transfer call). LRU-evicted down to the configured byte budget;
+    rewritten files miss naturally via the mtime/size key."""
+
+    def __init__(self):
+        self._entries: "dict" = {}     # key -> [DeviceBatch]
+        self._bytes: Dict[Any, int] = {}
+        self._total = 0
+
+    def get(self, key):
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._entries[key] = e     # move to MRU position
+        return e
+
+    def put(self, key, batches, budget: int):
+        size = sum(b.device_size_bytes() for b in batches)
+        if size > budget:
+            return
+        while self._total + size > budget and self._entries:
+            old_key = next(iter(self._entries))
+            self._entries.pop(old_key)
+            self._total -= self._bytes.pop(old_key)
+        self._entries[key] = list(batches)
+        self._bytes[key] = size
+        self._total += size
+
+    def clear(self):
+        self._entries.clear()
+        self._bytes.clear()
+        self._total = 0
+
+
+DEVICE_SCAN_CACHE = DeviceScanCache()
+
+
 class FileScanExec(LeafExec):
     """Leaf scan over N files in a format, with reader strategies.
     Splits at scan-unit (row-group/stripe) granularity and applies pushed
@@ -282,28 +326,72 @@ class FileScanExec(LeafExec):
                                           rows, self._columns)
 
     # -- device engine -------------------------------------------------------
+    def _unit_cache_key(self, unit: ScanUnit, rows: int):
+        try:
+            st = os.stat(unit.path)
+        except OSError:
+            return None
+        return (unit.path, st.st_mtime_ns, st.st_size, unit.index,
+                tuple(self._columns), rows)
+
     def execute_device(self, ctx, partition):
         m = ctx.metrics_for(self)
         rt = self._reader_type(ctx)
         rows = self._batch_rows(ctx)
         units = self._units_of(partition, m)
-        if rt == "MULTITHREADED":
-            yield from self._device_multithreaded(ctx, m, units, rows,
-                                                  partition)
+        budget = int(ctx.conf.get(C.SCAN_CACHE_BYTES))
+        # COALESCING merges units into one upload, so its outputs have no
+        # per-unit identity to cache under; the per-unit strategies cache.
+        use_cache = budget > 0 and rt != "COALESCING"
+        if not use_cache:
+            if rt == "MULTITHREADED":
+                yield from self._device_multithreaded(ctx, m, units, rows,
+                                                      partition, 0)
+            elif rt == "COALESCING":
+                yield from self._device_coalescing(ctx, m, units, rows)
+            else:
+                yield from self._device_perfile(ctx, m, units, rows,
+                                                partition, 0)
             return
-        if rt == "COALESCING":
-            yield from self._device_coalescing(ctx, m, units, rows)
-            return
-        for unit in units:   # PERFILE
+        # Serve cache hits inline; read contiguous miss runs through the
+        # configured reader strategy (which inserts them into the cache).
+        read = self._device_multithreaded if rt == "MULTITHREADED" \
+            else self._device_perfile
+        run: List[ScanUnit] = []
+        for unit in units:
+            hit = DEVICE_SCAN_CACHE.get(self._unit_cache_key(unit, rows))
+            if hit is None:
+                run.append(unit)
+                continue
+            if run:
+                yield from read(ctx, m, run, rows, partition, budget)
+                run = []
+            m.add("scanCacheHits", 1)
             self._publish_input_file(ctx, partition, unit.path)
+            for b in hit:
+                m.add("numOutputBatches", 1)
+                yield b
+        if run:
+            yield from read(ctx, m, run, rows, partition, budget)
+
+    def _device_perfile(self, ctx, m, units, rows, partition, budget):
+        for unit in units:
+            self._publish_input_file(ctx, partition, unit.path)
+            ubatches = []
             for hb in _read_unit_batches(self.fmt, unit, self.options,
                                          rows, self._columns):
                 with timed(m, "bufferTime"):
                     batch = host_to_device(hb)
                 m.add("numOutputBatches", 1)
+                ubatches.append(batch)
                 yield batch
+            if budget > 0:
+                key = self._unit_cache_key(unit, rows)
+                if key is not None:
+                    DEVICE_SCAN_CACHE.put(key, ubatches, budget)
 
-    def _device_multithreaded(self, ctx, m, units, rows, partition):
+    def _device_multithreaded(self, ctx, m, units, rows, partition,
+                              budget=0):
         """Background host decode overlapped with device consumption
         (MultiFileCloudParquetPartitionReader's thread-pool overlap,
         GpuParquetScan.scala:1144). Streaming: at most ``nthreads`` units
@@ -341,11 +429,17 @@ class FileScanExec(LeafExec):
                 if nxt is not None:
                     inflight.append((nxt, pool.submit(read_unit, nxt)))
                 self._publish_input_file(ctx, partition, unit.path)
+                ubatches = []
                 for enc in encoded:
                     with timed(m, "bufferTime"):
                         batch = wire.upload_encoded(*enc)
                     m.add("numOutputBatches", 1)
+                    ubatches.append(batch)
                     yield batch
+                if budget > 0:
+                    key = self._unit_cache_key(unit, rows)
+                    if key is not None:
+                        DEVICE_SCAN_CACHE.put(key, ubatches, budget)
 
     def _device_coalescing(self, ctx, m, units, rows):
         """Concatenate small units' rows into fewer, larger uploads

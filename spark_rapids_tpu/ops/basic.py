@@ -136,6 +136,9 @@ class ProjectExec(Exec):
         for batch in self.children[0].execute_device(ctx, partition):
             with timed(m):
                 out = fn(batch)
+            # Projection preserves row count — keep the host-known hint so
+            # downstream size consumers skip their device sync.
+            out.rows_hint = batch.rows_hint
             m.add("numOutputBatches", 1)
             yield out
 

@@ -129,6 +129,13 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
         jnp.maximum, jnp.where(starts, idx, 0))
     run_pos = idx - last_start
     max_run = jnp.max(jnp.where(s_match, run_pos + 1, 0))
+    # Start the device->host copy of the fast-path bound now: the stream
+    # loop reads it before the first probe batch, and overlapping the pull
+    # with probe-side startup hides a full link round trip.
+    try:
+        max_run.copy_to_host_async()
+    except AttributeError:      # tracer (jit) context: no-op
+        pass
     return BuiltSide(sorted_batch, s_fp, s_match, s_live,
                      batch.num_rows, list(key_ordinals), null_safe,
                      max_run)

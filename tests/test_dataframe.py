@@ -310,6 +310,55 @@ class TestIO:
             back = s.read.parquet(*paths)
             assert len(back.collect()) == 8
 
+    def test_device_scan_cache_hits_and_invalidates(self, session,
+                                                    tmp_path):
+        from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
+        from spark_rapids_tpu.ops.base import ExecContext
+        DEVICE_SCAN_CACHE.clear()
+        df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
+        out = str(tmp_path / "tc")
+        df.write.mode("overwrite").parquet(out)
+        paths = sorted(str(p) for p in (tmp_path / "tc").glob("part-*"))
+        back = session.read.parquet(*paths)
+        first = sorted(map(repr, back.collect()))
+        phys = back._physical()
+        ctx = ExecContext(phys.conf)
+        second = sorted(map(repr, phys.collect(ctx)))
+        assert second == first
+        hits = sum(m.values.get("scanCacheHits", 0)
+                   for m in ctx.metrics.values())
+        assert hits > 0, "second scan should be served from device cache"
+        ctx.close()
+        # Rewriting the file must invalidate (mtime/size key).
+        session.create_dataframe(
+            {k: list(reversed(v)) for k, v in DATA.items()}, SCHEMA,
+            num_partitions=2).write.mode("overwrite").parquet(out)
+        paths2 = sorted(str(p) for p in (tmp_path / "tc").glob("part-*"))
+        again = session.read.parquet(*paths2)
+        assert sorted(map(repr, again.collect())) == first
+
+    def test_scan_cache_disabled_by_zero_budget(self, tmp_path):
+        from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
+        from spark_rapids_tpu.ops.base import ExecContext
+        DEVICE_SCAN_CACHE.clear()
+        s = TpuSession({
+            "spark.rapids.sql.format.scanCache.maxBytes": 0,
+            "spark.rapids.sql.incompatibleOps.enabled": True,
+        })
+        df = s.create_dataframe(DATA, SCHEMA)
+        out = str(tmp_path / "tnc")
+        df.write.mode("overwrite").parquet(out)
+        back = s.read.parquet(*sorted(
+            str(p) for p in (tmp_path / "tnc").glob("part-*")))
+        back.collect()
+        phys = back._physical()
+        ctx = ExecContext(phys.conf)
+        phys.collect(ctx)
+        hits = sum(m.values.get("scanCacheHits", 0)
+                   for m in ctx.metrics.values())
+        assert hits == 0
+        ctx.close()
+
     def test_csv_roundtrip(self, session, tmp_path):
         schema = [("a", dt.INT64), ("b", dt.STRING)]
         df = session.create_dataframe(
