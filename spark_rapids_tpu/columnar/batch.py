@@ -303,10 +303,16 @@ def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
     fn = _JIT_CACHE.get(("sample", k))
     if fn is None:
         def _sample(b: DeviceBatch) -> DeviceBatch:
-            n = jnp.maximum(b.num_rows, 1)
-            idx = (jnp.arange(k, dtype=jnp.int32)
-                   * (n - 1)) // jnp.maximum(jnp.asarray(k - 1, jnp.int32),
-                                             1)
+            n = jnp.maximum(b.num_rows, 1).astype(jnp.int64)
+            slots = jnp.arange(k, dtype=jnp.int64)
+            strided = ((slots * (n - 1)) // jnp.maximum(
+                jnp.asarray(k - 1, jnp.int64), 1)).astype(jnp.int32)
+            slots = slots.astype(jnp.int32)
+            n = n.astype(jnp.int32)
+            # With fewer live rows than slots the stride collapses to
+            # mostly row 0; take the first n rows verbatim instead so
+            # range-bound probes see distinct rows.
+            idx = jnp.where(n > k, strided, jnp.minimum(slots, n - 1))
             take = jnp.minimum(jnp.asarray(k, jnp.int32), b.num_rows)
             return b.gather(idx, take)
         fn = jax.jit(_sample)

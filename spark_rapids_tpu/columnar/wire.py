@@ -8,12 +8,22 @@ analyzed (vectorized numpy, one pass) and, when lossless, re-encoded to a
 narrower wire type --
 
 - integers whose [min, max] fits int8/int16/int32 ship narrow;
-- float64 columns that are exactly a scaled decimal (prices with 2dp, whole
-  quantities: ``rint(v * scale) / scale == v`` bitwise) ship as scaled ints;
+- float64 columns whose values are whole numbers in int32 range ship as
+  ints (decoded by a pure int->f64 cast);
 - float64 exactly representable as float32 ships as float32;
 - all-valid validity vanishes (reconstructed from the row mask); otherwise
   it ships as packed bits (1/8th);
-- string length columns ship int16 (width <= 32k by construction).
+- string length columns ship int16 when the column width bounds them,
+  int32 otherwise.
+
+Only pure dtype CASTS are used on the device side. The TPU's float64 is
+double-double emulation whose arithmetic (add/mul/div) is NOT correctly
+rounded (measured ~2 ulps off), so any decode that computes — e.g. a
+scaled-decimal ``int / 100`` — lands on a different f64 than the host
+value and silently breaks bit-exact comparisons downstream (a filter
+``x <= 0.07`` dropped every 0.07 row). Casts int<->f64 and f32->f64 are
+exact on the emulated backend (verified), so the codec restricts itself
+to them.
 
 The device side widens back to the logical dtype inside ONE jitted decode
 program per (capacity, spec) -- a few fused casts, so HBM traffic is the
@@ -38,8 +48,8 @@ from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
 
 # Column wire spec (static, hashable -- part of the decode jit cache key):
-#   numeric: ("num", logical_name, wire_np_name, scale, vmode)
-#   string:  ("str", width, vmode)
+#   numeric: ("num", logical_name, wire_np_name, vmode)
+#   string:  ("str", width, lengths_np_name, vmode)
 # vmode: "all" (validity == row mask) | "packed" (bit-packed uint8).
 
 _INT_CANDIDATES = (
@@ -47,11 +57,6 @@ _INT_CANDIDATES = (
     (np.int16, -32768, 32767),
     (np.int32, -(2 ** 31), 2 ** 31 - 1),
 )
-
-# Decimal scales tried for exact float64 re-encoding, cheapest-win first:
-# whole numbers, then money (2dp), then 1dp.
-_FLOAT_SCALES = (1, 100, 10)
-
 
 def _narrow_int(values: np.ndarray, itemsize: int):
     """Smallest int dtype whose range covers values (None = keep)."""
@@ -68,25 +73,23 @@ def _narrow_int(values: np.ndarray, itemsize: int):
 
 
 def _encode_float64(values: np.ndarray):
-    """Returns (wire_array, wire_np_name, scale) or None. Lossless only:
-    decode(encode(v)) must equal v bitwise -- NaN/inf/-0.0 all disqualify
-    the scaled path (and -0.0 would silently become +0.0)."""
-    if values.size and not np.isfinite(values).all():
-        return None
-    if values.size and np.any((values == 0) & np.signbit(values)):
-        return None
-    for scale in _FLOAT_SCALES:
-        w = values * scale
-        r = np.rint(w)
-        if np.any(np.abs(r) > 2 ** 31 - 1):
-            continue
-        if not np.array_equal(r / scale, values):
-            continue
-        narrow = _narrow_int(r, 8) or np.int32
-        return r.astype(narrow), np.dtype(narrow).name, scale
+    """Returns (wire_array, wire_np_name) or None. Lossless only, and the
+    device decode must be a pure CAST (emulated-f64 arithmetic is not
+    correctly rounded — see module docstring): whole numbers in int32
+    range ship as narrow ints; exactly-f32-representable ships as f32.
+    NaN/inf/-0.0 disqualify the int path (-0.0 would become +0.0)."""
+    with np.errstate(invalid="ignore"):
+        finite = np.isfinite(values).all() if values.size else True
+    if finite and not (values.size
+                       and np.any((values == 0) & np.signbit(values))):
+        r = np.rint(values)
+        if not np.any(np.abs(r) > 2 ** 31 - 1) \
+                and np.array_equal(r, values):
+            narrow = _narrow_int(r, 8) or np.int32
+            return r.astype(narrow), np.dtype(narrow).name
     f32 = values.astype(np.float32)
     if np.array_equal(f32.astype(np.float64), values):
-        return f32, "float32", 0
+        return f32, "float32"
     return None
 
 
@@ -113,20 +116,23 @@ def encode_column(hc, name: str, n: int, cap: int,
         data = np.zeros((cap, want), dtype=np.uint8)
         w = min(want, m.shape[1])
         data[:n, :w] = np.where(hc.validity[:, None], m, 0)[:, :w]
-        lengths = np.zeros(cap, dtype=np.int16)
+        # Lengths are bounded by the column width: int16 only when the
+        # width itself fits (a >32767-byte string would otherwise wrap).
+        len_t = np.int16 if want <= 32767 else np.int32
+        lengths = np.zeros(cap, dtype=len_t)
         lengths[:n] = lens
-        return [data, lengths] + varrs, ("str", want, vmode)
+        return [data, lengths] + varrs, ("str", want,
+                                         np.dtype(len_t).name, vmode)
 
     values = np.where(hc.validity, hc.data,
                       np.zeros(1, hc.dtype.np_dtype)) \
         .astype(hc.dtype.np_dtype, copy=False)
     wire = values
     wire_name = hc.dtype.np_dtype.name
-    scale = 0
     if hc.dtype.np_dtype == np.float64:
         enc = _encode_float64(values)
         if enc is not None:
-            wire, wire_name, scale = enc
+            wire, wire_name = enc
     elif hc.dtype.np_dtype.kind == "i":
         narrow = _narrow_int(values, hc.dtype.itemsize)
         if narrow is not None:
@@ -134,13 +140,7 @@ def encode_column(hc, name: str, n: int, cap: int,
             wire_name = np.dtype(narrow).name
     data = np.zeros(cap, dtype=wire.dtype)
     data[:n] = wire
-    # The scale ships as a RUNTIME f64 scalar: a constant denominator lets
-    # XLA strength-reduce the divide into a reciprocal multiply, which is
-    # not correctly rounded and would break the bit-exact round trip the
-    # host-side check guarantees (true IEEE division is exact here).
-    sarr = [np.asarray(float(scale), np.float64)] if scale else []
-    return [data] + sarr + varrs, ("num", hc.dtype.name, wire_name, scale,
-                                   vmode)
+    return [data] + varrs, ("num", hc.dtype.name, wire_name, vmode)
 
 
 _DECODE_JIT_CACHE: dict = {}
@@ -160,7 +160,7 @@ def _decode_fn(cap: int, specs: tuple):
         cols = []
         for spec in specs:
             if spec[0] == "str":
-                _, width, vmode = spec
+                _, width, _len_name, vmode = spec
                 data = next(it)
                 lengths = next(it).astype(jnp.int32)
                 if vmode == "packed":
@@ -173,15 +173,13 @@ def _decode_fn(cap: int, specs: tuple):
                 cols.append(DeviceColumn(dt.STRING, data, validity,
                                          lengths))
                 continue
-            _, logical_name, wire_name, scale, vmode = spec
+            _, logical_name, wire_name, vmode = spec
             logical = dt.type_named(logical_name)
             w = next(it)
-            if scale:
-                data = w.astype(logical.np_dtype) / next(it)
-            elif w.dtype == logical.np_dtype:
+            if w.dtype == logical.np_dtype:
                 data = w
             else:
-                data = w.astype(logical.np_dtype)
+                data = w.astype(logical.np_dtype)   # pure cast, exact
             if vmode == "packed":
                 validity = _unpack_validity(next(it), cap)
             else:

@@ -587,14 +587,16 @@ class ConcatWs(Expression):
         if not self._children:
             n = batch.capacity
             return make_column(dt.STRING, jnp.zeros((n, 1), np.uint8),
-                               jnp.ones((n,), jnp.bool_),
+                               batch.row_mask(),
                                jnp.zeros((n,), jnp.int32))
         cols = []
         for c in self._children:
             col = as_device_column(c.eval(batch), batch)
             cols.append((col.data, col.lengths, col.validity))
         data, lengths = self._run(jnp, cols)
-        valid = jnp.ones((batch.capacity,), jnp.bool_)
+        # concat_ws is never NULL itself, but padding rows must stay
+        # invalid (batch.py engine invariant: padding validity is False).
+        valid = batch.row_mask()
         return make_column(dt.STRING, data, valid, lengths)
 
     def eval_host(self, batch):

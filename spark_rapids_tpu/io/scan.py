@@ -268,6 +268,8 @@ class FileScanExec(LeafExec):
         self.options = dict(options or {})
         self._columns = [n for n, _ in self._schema]
         self.predicates = tuple(predicates)
+        self._opts_key = tuple(sorted((str(k), str(v))
+                                      for k, v in self.options.items()))
         self._units = enumerate_units(fmt, self.paths)
         self._parts = num_partitions or min(len(self._units), 8) or 1
         # input_file_name() in the plan: batches must not span files.
@@ -331,8 +333,11 @@ class FileScanExec(LeafExec):
             st = os.stat(unit.path)
         except OSError:
             return None
-        return (unit.path, st.st_mtime_ns, st.st_size, unit.index,
-                tuple(self._columns), rows)
+        # Reader options and the user schema change how the same bytes
+        # decode (CSV delimiter/header, imposed types): they must key the
+        # cache or two differently-configured scans would share entries.
+        return (self.fmt, unit.path, st.st_mtime_ns, st.st_size, unit.index,
+                self._schema, self._opts_key, rows)
 
     def execute_device(self, ctx, partition):
         m = ctx.metrics_for(self)

@@ -120,33 +120,44 @@ class ShuffleExchangeExec(Exec):
         from spark_rapids_tpu.columnar.batch import shrink_to_capacity
         from spark_rapids_tpu.memory.stores import (
             PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
-        # Two-phase sizes-then-data (SURVEY §7): materialize every child
-        # batch first, pull all unknown row counts in ONE device_get, and
-        # shrink each batch to its live bucket before splitting. Partial
-        # aggregates and selective filters yield at input capacity; one
-        # batched sync here replaces a per-partition sync there, and the
-        # split + spill accounting then work at live scale.
-        child_batches: List[DeviceBatch] = []
+        # Two-phase sizes-then-data (SURVEY §7): pull unknown row counts in
+        # a BATCHED device_get and shrink each batch to its live bucket
+        # before splitting. Partial aggregates and selective filters yield
+        # at input capacity; one batched sync per window replaces a
+        # per-partition sync. The window is bounded so pre-split batches
+        # never accumulate unboundedly in un-spillable HBM (a shuffle whose
+        # input exceeds device memory must be able to spill mid-shuffle).
+        _WINDOW = 32
+
+        def flush_window(window: List[DeviceBatch]):
+            counts = [b.rows_hint for b in window]
+            unknown = [i for i, c in enumerate(counts) if c is None]
+            if unknown:
+                pulled = jax.device_get(
+                    [window[i].num_rows for i in unknown])
+                for i, c in zip(unknown, pulled):
+                    counts[i] = int(c)
+            for batch, cnt in zip(window, counts):
+                batch = shrink_to_capacity(batch,
+                                           bucket_capacity(max(cnt, 1)))
+                pieces = split(batch)
+                for p, piece in enumerate(pieces):
+                    # Shuffle output is spillable (RapidsCachingWriter
+                    # inserts into the device store; shuffle spills FIRST
+                    # per SpillPriorities) — the bucket holds a handle,
+                    # not a pinned device batch.
+                    buckets[p].append(SpillableBatch(
+                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+
+        window: List[DeviceBatch] = []
         for cp in range(self.children[0].num_partitions(ctx)):
-            child_batches.extend(self.children[0].execute_device(ctx, cp))
-        counts = [b.rows_hint for b in child_batches]
-        unknown = [i for i, c in enumerate(counts) if c is None]
-        if unknown:
-            pulled = jax.device_get(
-                [child_batches[i].num_rows for i in unknown])
-            for i, c in zip(unknown, pulled):
-                counts[i] = int(c)
-        for batch, cnt in zip(child_batches, counts):
-            batch = shrink_to_capacity(batch,
-                                       bucket_capacity(max(cnt, 1)))
-            pieces = split(batch)
-            for p, piece in enumerate(pieces):
-                # Shuffle output is spillable (RapidsCachingWriter
-                # inserts into the device store; shuffle spills FIRST
-                # per SpillPriorities) — the bucket holds a handle,
-                # not a pinned device batch.
-                buckets[p].append(SpillableBatch(
-                    ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+            for b in self.children[0].execute_device(ctx, cp):
+                window.append(b)
+                if len(window) >= _WINDOW:
+                    flush_window(window)
+                    window = []
+        if window:
+            flush_window(window)
         ctx.cache[key] = buckets
         return buckets
 
