@@ -1,0 +1,141 @@
+"""Microbenchmark engine kernels on the real device: where do q1's 14s go?"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def _sync(out):
+    """block_until_ready on the axon tunnel acks the dispatch, not the
+    completion; pull one scalar to force a true round trip."""
+    leaves = jax.tree_util.tree_leaves(out)
+    jax.device_get(jnp.sum(leaves[0].ravel()[:1]))
+
+
+def timeit(name, fn, *args, n=3):
+    # warmup/compile
+    out = fn(*args)
+    _sync(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _sync(out)
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {min(ts)*1000:.1f} ms")
+    return out
+
+
+def main():
+    import spark_rapids_tpu  # noqa: F401  (x64 config)
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+    from spark_rapids_tpu.ops import kernels
+
+    cap = 1 << 20
+    rng = np.random.default_rng(0)
+
+    # Columns shaped like q1's lineitem batch
+    f64 = lambda: jnp.asarray(rng.uniform(0, 1e5, cap))
+    i32 = lambda: jnp.asarray(rng.integers(8000, 11000, cap), jnp.int32)
+    s1 = jnp.asarray(rng.integers(65, 68, (cap, 8)), jnp.uint8)
+    ones = jnp.ones((cap,), jnp.bool_)
+    lens = jnp.full((cap,), 1, jnp.int32)
+
+    nrows = jnp.asarray(cap - 7, jnp.int32)
+
+    cols = [
+        DeviceColumn(dt.STRING, s1, ones, lens),          # returnflag
+        DeviceColumn(dt.STRING, s1, ones, lens),          # linestatus
+        DeviceColumn(dt.FLOAT64, f64(), ones),            # quantity
+        DeviceColumn(dt.FLOAT64, f64(), ones),            # extendedprice
+        DeviceColumn(dt.FLOAT64, f64(), ones),            # discount
+        DeviceColumn(dt.FLOAT64, f64(), ones),            # tax
+        DeviceColumn(dt.DATE, i32(), ones),               # shipdate
+    ]
+    batch = DeviceBatch(tuple(cols), nrows)
+    jax.block_until_ready(batch)
+
+    # 1. fingerprint
+    fp = jax.jit(lambda b: kernels.key_fingerprint(
+        [b.columns[0], b.columns[1]], cap))
+    timeit("fingerprint 2 str cols", fp, batch)
+
+    # 2. single stable argsort u32
+    keys = jnp.asarray(rng.integers(0, 2**32, cap, dtype=np.uint32))
+    timeit("argsort u32 1M", jax.jit(lambda k: jnp.argsort(k, stable=True)),
+           keys)
+
+    # 3. group_ids (3 argsorts via fingerprint)
+    def _gi(b):
+        g_ = kernels.group_ids(b, [0, 1])
+        return (g_.perm, g_.group_of_sorted, g_.num_groups, g_.group_leader)
+    gi = jax.jit(_gi)
+    gt = timeit("group_ids (2 str keys)", gi, batch)
+    import types
+    g = types.SimpleNamespace(perm=gt[0], group_of_sorted=gt[1],
+                              num_groups=gt[2], group_leader=gt[3])
+
+    # 4. segment_sum f64 1M
+    gid = g.group_of_sorted
+    vals = batch.columns[2].data
+    timeit("segment_sum f64 1M->1M segs",
+           jax.jit(lambda v, g_: jax.ops.segment_sum(v, g_,
+                                                     num_segments=cap)),
+           vals, gid)
+    vals32 = vals.astype(jnp.float32)
+    timeit("segment_sum f32 1M",
+           jax.jit(lambda v, g_: jax.ops.segment_sum(v, g_,
+                                                     num_segments=cap)),
+           vals32, gid)
+
+    # 5. filter compact on the 7-col batch
+    keep = batch.columns[6].data <= 10000
+    timeit("compact 7col 1M",
+           jax.jit(lambda b, k: b.compact(k)), batch, keep)
+
+    # 6. f64 multiply + sum (q1 projections)
+    timeit("f64 mul x3 1M", jax.jit(
+        lambda a, b, c: a * (1.0 - b) * (1.0 + c)),
+        vals, batch.columns[4].data, batch.columns[5].data)
+
+    # 7. gather 7 cols by perm
+    perm = jnp.asarray(rng.permutation(cap), jnp.int32)
+    timeit("gather 7col 1M", jax.jit(
+        lambda b, p: b.gather(p, b.num_rows)), batch, perm)
+
+    # 8. f64 argsort (join/sort path)
+    timeit("argsort f64 1M", jax.jit(
+        lambda v: jnp.argsort(v, stable=True)), vals)
+
+    # 9. searchsorted 1M into 1M (join probe)
+    sk = jnp.sort(keys)
+    timeit("searchsorted 1M/1M", jax.jit(
+        lambda s, q: jnp.searchsorted(s, q)), sk, keys)
+
+    # 10. full agg update_batch (q1 partial agg analog)
+    from spark_rapids_tpu.ops.aggregate import (
+        AggSpec, Average, Count, HashAggregateExec, Sum)
+    from spark_rapids_tpu.exprs.base import BoundReference as BR
+    agg = HashAggregateExec.__new__(HashAggregateExec)
+    agg.group_names = ("rf", "ls")
+    agg.group_exprs = [BR(0, dt.STRING), BR(1, dt.STRING)]
+    agg.aggs = [AggSpec("s1", Sum(BR(2, dt.FLOAT64))),
+                AggSpec("s2", Sum(BR(3, dt.FLOAT64))),
+                AggSpec("a1", Average(BR(2, dt.FLOAT64))),
+                AggSpec("c", Count(BR(2, dt.FLOAT64)))]
+    agg.mode = "partial"
+    upd = jax.jit(agg._update_batch)
+    timeit("q1-like update_batch 1M", upd, batch,
+           jnp.asarray(0, jnp.int64))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Attribute q3 wall time: per-exec metrics + phase timers.
+
+Usage: python scripts/profile_q3.py [q1|q6|q3|q5] [iters]
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    qn = sys.argv[1] if len(sys.argv) > 1 else "q3"
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.ops.base import ExecContext
+
+    data_dir = os.environ.get("TPCH_DIR", "/tmp/srt_tpch_sf1")
+    tpch.generate(data_dir, scale=1.0)
+
+    session = TpuSession()
+    session.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    df = tpch.QUERIES[qn](session, data_dir)
+
+    # Warmup (compile)
+    t0 = time.perf_counter()
+    df.collect()
+    print(f"warmup: {time.perf_counter()-t0:.2f}s")
+
+    for it in range(iters):
+        phys = df._physical()
+        ctx = ExecContext(phys.conf)
+        t0 = time.perf_counter()
+        rows = phys.root.collect(ctx, device=phys.root_on_device)
+        wall = time.perf_counter() - t0
+        print(f"\n=== iter {it}: wall {wall:.3f}s, {len(rows)} rows ===")
+        for key, m in sorted(ctx.metrics.items()):
+            vals = {k: (round(v / 1e9, 3) if "Time" in k else v)
+                    for k, v in m.values.items()}
+            print(f"  {key}: {vals}")
+        ctx.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -35,9 +35,12 @@ _jax.config.update("jax_enable_x64", True)
 # processes (the engine's capacity-bucket ladder keeps the program count
 # bounded, so the cache converges quickly).
 if not _os.environ.get("SRT_NO_COMPILE_CACHE"):
+    _default_cache = _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        ".jax_cache")
     _jax.config.update(
         "jax_compilation_cache_dir",
-        _os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/srt_jax_cache"))
+        _os.environ.get("JAX_COMPILATION_CACHE_DIR", _default_cache))
     _jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 

@@ -143,6 +143,24 @@ class DataFrame:
 
     where = filter
 
+    def _project(self, projections) -> "DataFrame":
+        """Build a projection, extracting window expressions into a chain
+        of LogicalWindow nodes first (ExtractWindowExpressions analog)."""
+        plan = self._plan
+        out = []
+        for i, (name, c) in enumerate(projections):
+            if L.is_window_column(c):
+                node = c.node
+                while node[0] == "alias":
+                    node = node[1].node
+                _, fn_col, windef = node
+                tmp = f"__window_{i}_{name}"
+                plan = L.LogicalWindow(plan, tmp, fn_col, windef)
+                out.append((name, col(tmp)))
+            else:
+                out.append((name, c))
+        return DataFrame(self._session, L.LogicalProject(plan, out))
+
     def select(self, *cols_: Union[str, Column]) -> "DataFrame":
         projections = []
         for c in cols_:
@@ -150,8 +168,7 @@ class DataFrame:
                 projections.append((c, col(c)))
             else:
                 projections.append((c.name_hint, c))
-        return DataFrame(self._session,
-                         L.LogicalProject(self._plan, projections))
+        return self._project(projections)
 
     def with_column(self, name: str, c: Column) -> "DataFrame":
         # Replace in place like pyspark's withColumn; append when new.
@@ -161,8 +178,7 @@ class DataFrame:
         else:
             projections = [(n, col(n)) for n in self.columns]
             projections.append((name, c))
-        return DataFrame(self._session,
-                         L.LogicalProject(self._plan, projections))
+        return self._project(projections)
 
     withColumn = with_column
 

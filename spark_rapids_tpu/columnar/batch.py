@@ -139,21 +139,35 @@ class DeviceBatch:
     (filter/join/groupby outputs) never force a recompile; ``capacity`` is
     static. Mirrors the role of the reference's ColumnarBatch of
     GpuColumnVectors (GpuColumnVector.java:from(Table)).
+
+    ``sel`` is an optional (capacity,) bool SELECTION VECTOR: rows inside
+    the ``num_rows`` prefix with sel False are deleted. Filters and join
+    emits produce sel-batches instead of compacting (a 1M-row packed
+    compaction costs ~100-400ms of device time on the target chip; a mask
+    costs nothing) — the Velox/DuckDB selection-vector idea applied at
+    batch granularity. Compaction happens only at materialization points
+    (exchange, concat, sort output, download) via columnar/rowmove.py.
     """
 
     columns: Tuple[DeviceColumn, ...]
     num_rows: jax.Array          # int32 scalar
-    # Host-known exact row count, when the producer knows it (uploads do).
-    # NOT a pytree leaf: jit-produced batches lose it (None = unknown).
+    # Host-known exact LIVE row count, when the producer knows it (uploads
+    # do). NOT a pytree leaf: jit-produced batches lose it (None = unknown).
     # Lets consumers (exchange shrink, downloads) skip a device->host sync.
     rows_hint: Optional[int] = dataclasses.field(
         default=None, compare=False)
+    sel: Optional[jax.Array] = None   # (capacity,) bool; None = all prefix
 
     def tree_flatten(self):
-        return (tuple(self.columns), self.num_rows), None
+        if self.sel is not None:
+            return (tuple(self.columns), self.num_rows, self.sel), True
+        return (tuple(self.columns), self.num_rows), False
 
     @classmethod
-    def tree_unflatten(cls, _aux, leaves):
+    def tree_unflatten(cls, has_sel, leaves):
+        if has_sel:
+            columns, num_rows, sel = leaves
+            return cls(tuple(columns), num_rows, sel=sel)
         columns, num_rows = leaves
         return cls(tuple(columns), num_rows)
 
@@ -166,39 +180,47 @@ class DeviceBatch:
         return len(self.columns)
 
     def row_mask(self) -> jax.Array:
-        """(capacity,) bool — True for live (non-padding) rows."""
-        return jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+        """(capacity,) bool — True for live (non-padding, selected) rows."""
+        mask = jnp.arange(self.capacity, dtype=jnp.int32) < self.num_rows
+        if self.sel is not None:
+            mask = mask & self.sel
+        return mask
+
+    def live_count(self) -> jax.Array:
+        """int32 scalar: number of live rows (== num_rows when no sel)."""
+        if self.sel is None:
+            return jnp.asarray(self.num_rows, jnp.int32)
+        return jnp.sum(self.row_mask().astype(jnp.int32))
+
+    def with_sel(self, keep: jax.Array) -> "DeviceBatch":
+        """Restrict live rows by ``keep`` without moving data (lazy
+        filter). rows_hint is dropped — the live count changed."""
+        sel = keep if self.sel is None else (self.sel & keep)
+        return DeviceBatch(self.columns, self.num_rows, sel=sel)
 
     # -- row movement --------------------------------------------------------
     def gather(self, indices: jax.Array, new_num_rows: jax.Array) -> "DeviceBatch":
-        cap = indices.shape[0]
-        valid_dst = jnp.arange(cap, dtype=jnp.int32) < new_num_rows
-        cols = tuple(c.gather(indices, valid_dst) for c in self.columns)
-        return DeviceBatch(cols, jnp.asarray(new_num_rows, jnp.int32))
+        from spark_rapids_tpu.columnar.rowmove import gather_rows
+        return gather_rows(self, indices,
+                           jnp.asarray(new_num_rows, jnp.int32))
 
     def compact(self, keep: jax.Array) -> "DeviceBatch":
-        """Keep rows where ``keep`` (already ANDed with row_mask) — stable.
-
-        The engine's row-compaction primitive (cuDF ``Table.filter`` analog):
-        positions = exclusive cumsum of keep; scatter-with-drop packs kept
-        rows to the front. O(n), single pass, XLA-fusable.
-        """
-        keep = keep & self.row_mask()
-        positions = jnp.cumsum(keep.astype(jnp.int32)) - 1
-        positions = jnp.where(keep, positions, self.capacity)  # dropped
-        new_rows = jnp.sum(keep.astype(jnp.int32))
-        cols = tuple(c.scatter(positions, self.capacity) for c in self.columns)
-        return DeviceBatch(cols, new_rows)
+        """Materialize rows where ``keep`` (ANDed with row_mask) as a packed
+        prefix — the cuDF ``Table.filter`` analog, via one packed scatter
+        per slab (columnar/rowmove.py)."""
+        from spark_rapids_tpu.columnar.rowmove import compact_batch
+        return compact_batch(self, keep)
 
     def head(self, n: jax.Array) -> "DeviceBatch":
-        """First min(n, num_rows) rows (GpuLocalLimit analog)."""
-        new_rows = jnp.minimum(jnp.asarray(n, jnp.int32), self.num_rows)
-        mask = jnp.arange(self.capacity, dtype=jnp.int32) < new_rows
-        cols = tuple(c.with_validity(c.validity & mask) for c in self.columns)
-        return DeviceBatch(cols, new_rows)
+        """First min(n, live) rows (GpuLocalLimit analog) — selection-only,
+        no data movement."""
+        live = self.row_mask()
+        keep = jnp.cumsum(live.astype(jnp.int32)) <= jnp.asarray(n, jnp.int32)
+        return self.with_sel(keep & live)
 
     def select(self, indices: Sequence[int]) -> "DeviceBatch":
-        return DeviceBatch(tuple(self.columns[i] for i in indices), self.num_rows)
+        return DeviceBatch(tuple(self.columns[i] for i in indices),
+                           self.num_rows, sel=self.sel)
 
     @property
     def dtypes(self) -> Tuple[DataType, ...]:
@@ -212,56 +234,26 @@ class DeviceBatch:
             total += c.validity.size  # bool = 1 byte
             if c.lengths is not None:
                 total += c.lengths.size * 4
+        if self.sel is not None:
+            total += self.sel.size
         return total
 
 
 def concat_batches(batches: Sequence[DeviceBatch], capacity: int) -> DeviceBatch:
-    """Concatenate batches into one of ``capacity`` rows.
+    """Concatenate the live rows of ``batches`` into one dense batch of
+    ``capacity`` rows.
 
     The cuDF ``Table.concatenate`` analog used by GpuCoalesceBatches
-    (GpuCoalesceBatches.scala:643). Capacities are static, so overflow is
-    checked at trace time: sum of member capacities must fit.
-    Strings are re-padded to the widest member width.
+    (GpuCoalesceBatches.scala:643), via one packed scatter per member
+    (columnar/rowmove.py) — selection vectors compact away here.
+    Capacities are static, so overflow is checked at trace time.
     """
     assert batches, "concat of zero batches"
     total_cap = sum(b.capacity for b in batches)
     assert total_cap <= capacity, (
         f"concat overflow: member capacities sum to {total_cap} > {capacity}")
-    ncols = batches[0].num_columns
-    out_cols: List[DeviceColumn] = []
-    total_rows = sum((b.num_rows for b in batches),
-                     start=jnp.asarray(0, jnp.int32))
-    # Destination offset of each batch = cumsum of preceding num_rows.
-    offsets = []
-    acc = jnp.asarray(0, jnp.int32)
-    for b in batches:
-        offsets.append(acc)
-        acc = acc + b.num_rows
-    for ci in range(ncols):
-        members = [b.columns[ci] for b in batches]
-        dtype = members[0].dtype
-        if dtype.is_string:
-            width = max(m.string_width for m in members)
-            members = [string_repad(m, width) for m in members]
-        # Fold all members into one accumulator with chained disjoint
-        # scatters — each destination element is written once.
-        shape = ((capacity, members[0].string_width) if dtype.is_string
-                 else (capacity,))
-        data = jnp.zeros(shape, members[0].data.dtype)
-        validity = jnp.zeros((capacity,), jnp.bool_)
-        lengths = jnp.zeros((capacity,), jnp.int32) if dtype.is_string else None
-        for b, m, off in zip(batches, members, offsets):
-            live = m.validity & b.row_mask()
-            pos = jnp.where(b.row_mask(),
-                            jnp.arange(b.capacity, dtype=jnp.int32) + off,
-                            capacity)
-            data = data.at[pos].set(_zero_dead(m.data, live), mode="drop")
-            validity = validity.at[pos].set(live, mode="drop")
-            if dtype.is_string:
-                lengths = lengths.at[pos].set(
-                    jnp.where(live, m.lengths, 0), mode="drop")
-        out_cols.append(DeviceColumn(dtype, data, validity, lengths))
-    return DeviceBatch(tuple(out_cols), total_rows)
+    from spark_rapids_tpu.columnar.rowmove import concat_compact
+    return concat_compact(batches, capacity)
 
 
 _JIT_CACHE: dict = {}
@@ -281,18 +273,25 @@ def jit_concat_batches(batches: Sequence[DeviceBatch],
 
 def shrink_to_capacity(batch: DeviceBatch, capacity: int) -> DeviceBatch:
     """Re-bucket a batch whose live rows fit a smaller capacity (after a
-    groupby/filter the packed prefix is all that matters). Jitted slice;
-    requires ``num_rows <= capacity <= batch.capacity``."""
-    if capacity >= batch.capacity:
+    groupby/filter the packed prefix is all that matters). Jitted;
+    requires ``live_count <= capacity``. Selection vectors compact away
+    (cost scales with the small OUTPUT capacity — rowmove.compact_to)."""
+    if capacity >= batch.capacity and batch.sel is None:
         return batch
+    hint = batch.rows_hint
     fn = _JIT_CACHE.get(("shrink", capacity))
     if fn is None:
         def _shrink(b: DeviceBatch) -> DeviceBatch:
+            from spark_rapids_tpu.columnar.rowmove import compact_to
+            if b.sel is not None:
+                return compact_to(b, capacity, b.live_count())
             idx = jnp.arange(capacity, dtype=jnp.int32)
             return b.gather(idx, b.num_rows)
         fn = jax.jit(_shrink)
         _JIT_CACHE[("shrink", capacity)] = fn
-    return fn(batch)
+    out = fn(batch)
+    out.rows_hint = hint
+    return out
 
 
 def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
@@ -303,6 +302,9 @@ def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
     fn = _JIT_CACHE.get(("sample", k))
     if fn is None:
         def _sample(b: DeviceBatch) -> DeviceBatch:
+            if b.sel is not None:
+                from spark_rapids_tpu.columnar.rowmove import compact_batch
+                b = compact_batch(b)
             n = jnp.maximum(b.num_rows, 1).astype(jnp.int64)
             slots = jnp.arange(k, dtype=jnp.int64)
             strided = ((slots * (n - 1)) // jnp.maximum(

@@ -244,11 +244,15 @@ def download_batches(batches: Sequence[DeviceBatch],
     from spark_rapids_tpu.columnar.batch import shrink_to_capacity
     batches = list(batches)
     counts: List[Optional[int]] = [b.rows_hint for b in batches]
+    # Selection-vector batches MUST materialize before download (their live
+    # rows are scattered); padded dense batches shrink only when the saved
+    # bytes beat the row-count sync. Both pulls share one device_get.
     unknown = [i for i, b in enumerate(batches)
                if counts[i] is None
-               and b.device_size_bytes() > _SHRINK_DOWNLOAD_BYTES]
+               and (b.sel is not None
+                    or b.device_size_bytes() > _SHRINK_DOWNLOAD_BYTES)]
     if unknown:
-        pulled = jax.device_get([batches[i].num_rows for i in unknown])
+        pulled = jax.device_get([batches[i].live_count() for i in unknown])
         for i, n in zip(unknown, pulled):
             counts[i] = int(n)
     for i, n in enumerate(counts):

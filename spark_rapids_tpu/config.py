@@ -133,6 +133,14 @@ BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
     "TPU addition: row capacity, not just bytes, is what bounds XLA "
     "recompilation.").long(1 << 20)
 
+AGG_SKIP_PARTIAL_RATIO = conf(
+    "spark.rapids.sql.agg.skipAggPassReductionRatio").doc(
+    "When the first partial-aggregation batch reduces its input by less "
+    "than this ratio (groups/rows above the threshold), remaining batches "
+    "skip pre-shuffle grouping and project rows straight into the buffer "
+    "layout; all grouping then happens once, after the exchange. 1.0 "
+    "disables skipping.").double(0.85)
+
 CONCURRENT_TPU_TASKS = conf("spark.rapids.sql.concurrentTpuTasks").doc(
     "Number of tasks that may issue work to one TPU chip concurrently "
     "(ref: spark.rapids.sql.concurrentGpuTasks / GpuSemaphore).").integer(2)

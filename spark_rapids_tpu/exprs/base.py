@@ -292,7 +292,7 @@ def eval_exprs(exprs: Sequence[Expression],
     """Project: evaluate expressions into a new device batch
     (GpuProjectExec's core, basicPhysicalOperators.scala:66)."""
     cols = tuple(as_device_column(e.eval(batch), batch) for e in exprs)
-    return DeviceBatch(cols, batch.num_rows)
+    return DeviceBatch(cols, batch.num_rows, sel=batch.sel)
 
 
 def eval_exprs_host(exprs: Sequence[Expression], batch: HostBatch,

@@ -53,7 +53,11 @@ def _batch_to_numpy(batch: DeviceBatch) -> Tuple[dict, list]:
             bufs.append(np.asarray(c.lengths))
             entry["has_lengths"] = True
         cols_meta.append(entry)
-    meta = {"cols": cols_meta, "num_rows": int(batch.num_rows)}
+    meta = {"cols": cols_meta, "num_rows": int(batch.num_rows),
+            "rows_hint": batch.rows_hint}
+    if batch.sel is not None:
+        bufs.append(np.asarray(batch.sel))
+        meta["has_sel"] = True
     return meta, bufs
 
 
@@ -69,8 +73,11 @@ def _numpy_to_batch(meta: dict, bufs: list) -> DeviceBatch:
         if entry.get("has_lengths"):
             lengths = jnp.asarray(bufs[bi]); bi += 1
         cols.append(DeviceColumn(t, data, validity, lengths))
-    return DeviceBatch(tuple(cols),
-                       jnp.asarray(meta["num_rows"], jnp.int32))
+    sel = jnp.asarray(bufs[bi]) if meta.get("has_sel") else None
+    out = DeviceBatch(tuple(cols),
+                      jnp.asarray(meta["num_rows"], jnp.int32), sel=sel)
+    out.rows_hint = meta.get("rows_hint")
+    return out
 
 
 def _serialize_bufs(bufs: list) -> Tuple[bytes, list]:

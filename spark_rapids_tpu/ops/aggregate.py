@@ -86,6 +86,42 @@ class AggFunction:
     def finalize(self, bufs: List[SortedCol]) -> Buf:
         raise NotImplementedError
 
+    # -- fast segmented-sum plan (cumsum path) ---------------------------
+    # Sum-decomposable aggregates (Sum/Count/Average) expose their work as
+    # masked value streams; HashAggregateExec stacks every stream of the
+    # whole spec list into per-dtype 2D arrays and computes ALL group sums
+    # with ONE cumsum + boundary-diff per dtype (f64 scatter-adds cost
+    # ~147ms/1M on this chip; a (1M, k) cumsum costs ~48ms TOTAL —
+    # scripts/microbench.py). None = not sum-decomposable (min/max/first/
+    # last keep the per-fn segment path).
+    def sum_terms_update(self, col: SortedCol) -> Optional[List[Tuple]]:
+        return None
+
+    def sum_terms_merge(self, bufs: List[SortedCol]) -> Optional[List[Tuple]]:
+        return None
+
+    def bufs_from_sums(self, sums: List, capacity: int) -> List[Buf]:
+        raise NotImplementedError
+
+    # -- global (zero-key) fast path -------------------------------------
+    # Whole-batch masked reductions — no sort, no segments. Returns one
+    # value per buffer as (scalar_data, scalar_valid, lengths_or_None).
+    def update_global(self, col: SortedCol, row_index=None,
+                      live=None) -> Optional[List[Tuple]]:
+        return None
+
+    def merge_global(self, bufs: List[SortedCol]) -> Optional[List[Tuple]]:
+        return None
+
+    # -- partial-skip passthrough ----------------------------------------
+    # Each input ROW becomes its own single-element group buffer — a pure
+    # elementwise projection into the buffer layout, used when the partial
+    # stage's measured reduction ratio is poor (the reference's later
+    # skipAggPassReductionRatio idea): grouping then happens once, after
+    # the exchange, instead of twice. None = unsupported.
+    def update_row(self, col: SortedCol, row_index) -> Optional[List[Buf]]:
+        return None
+
     # -- host oracle ----------------------------------------------------
     def host_update(self, values: list) -> tuple:
         """Group's python values (None=null) -> buffer value tuple."""
@@ -127,6 +163,30 @@ class Count(AggFunction):
         b, = bufs
         return b.data, b.validity, None
 
+    # -- fast paths ------------------------------------------------------
+    def sum_terms_update(self, col):
+        return [("i32", col.validity.astype(jnp.int32))]
+
+    def sum_terms_merge(self, bufs):
+        b, = bufs
+        return [("i64", jnp.where(b.validity, b.data, 0))]
+
+    def bufs_from_sums(self, sums, capacity):
+        s, = sums
+        return [(s.astype(jnp.int64), jnp.ones((capacity,), jnp.bool_),
+                 None)]
+
+    def update_global(self, col, row_index=None, live=None):
+        return [(jnp.sum(col.validity.astype(jnp.int64)), True, None)]
+
+    def update_row(self, col, row_index):
+        ones = jnp.ones_like(col.validity)
+        return [(col.validity.astype(jnp.int64), ones, None)]
+
+    def merge_global(self, bufs):
+        b, = bufs
+        return [(jnp.sum(jnp.where(b.validity, b.data, 0)), True, None)]
+
     def host_update(self, values):
         return (sum(1 for v in values if v is not None),)
 
@@ -138,12 +198,26 @@ class Count(AggFunction):
 
 
 class CountStar(Count):
+    def update_row(self, col, row_index):
+        ones = jnp.ones_like(col.validity)
+        return [(jnp.ones(col.validity.shape, jnp.int64), ones, None)]
+
     def host_update(self, values):
         return (len(values),)
 
 
 def _sum_result_type(t: dt.DataType) -> dt.DataType:
     return dt.FLOAT64 if t.is_floating else dt.INT64
+
+
+def _reapply_nonfinite(s, nan_cnt, pinf_cnt, ninf_cnt):
+    """Reconstruct IEEE sum semantics from a finite-only sum plus per-group
+    NaN/±inf occurrence counts (cumsum path carries non-finites out of
+    band)."""
+    bad = (nan_cnt > 0) | ((pinf_cnt > 0) & (ninf_cnt > 0))
+    s = jnp.where(pinf_cnt > 0, jnp.inf, s)
+    s = jnp.where(ninf_cnt > 0, -jnp.inf, s)
+    return jnp.where(bad, jnp.nan, s)
 
 
 class Sum(AggFunction):
@@ -170,6 +244,58 @@ class Sum(AggFunction):
     def finalize(self, bufs):
         b, = bufs
         return b.data, b.validity, None
+
+    # -- fast paths ------------------------------------------------------
+    @property
+    def _cls(self) -> str:
+        return "f64" if self.result_type.is_floating else "i64"
+
+    def _terms(self, data, validity):
+        """Masked value stream + count; float streams also carry NaN/inf
+        occurrence counts — the cumsum prefix-diff would otherwise let one
+        group's NaN poison every later group's sum."""
+        t = self.result_type.np_dtype
+        v = jnp.where(validity, data.astype(t), jnp.zeros((), t))
+        if self._cls != "f64":
+            return [("i64", v), ("i32", validity.astype(jnp.int32))]
+        finite = jnp.isfinite(v)
+        clean = jnp.where(finite, v, 0.0)
+        return [("f64", clean), ("i32", validity.astype(jnp.int32)),
+                ("i32", (validity & jnp.isnan(v)).astype(jnp.int32)),
+                ("i32", (v == jnp.inf).astype(jnp.int32)),
+                ("i32", (v == -jnp.inf).astype(jnp.int32))]
+
+    def sum_terms_update(self, col):
+        return self._terms(col.data, col.validity)
+
+    def sum_terms_merge(self, bufs):
+        b, = bufs
+        return self._terms(b.data, b.validity)
+
+    def bufs_from_sums(self, sums, capacity):
+        if self._cls != "f64":
+            s, c = sums
+            return [(s, c > 0, None)]
+        s, c, nan, pinf, ninf = sums
+        s = _reapply_nonfinite(s, nan, pinf, ninf)
+        return [(s, c > 0, None)]
+
+    def update_global(self, col, row_index=None, live=None):
+        t = self.result_type.np_dtype
+        v = jnp.where(col.validity, col.data.astype(t), jnp.zeros((), t))
+        return [(jnp.sum(v), jnp.sum(col.validity.astype(jnp.int32)) > 0,
+                 None)]
+
+    def update_row(self, col, row_index):
+        t = self.result_type.np_dtype
+        return [(col.data.astype(t), col.validity, None)]
+
+    def merge_global(self, bufs):
+        b, = bufs
+        t = self.result_type.np_dtype
+        v = jnp.where(b.validity, b.data.astype(t), jnp.zeros((), t))
+        return [(jnp.sum(v), jnp.sum(b.validity.astype(jnp.int32)) > 0,
+                 None)]
 
     def host_update(self, values):
         vs = [v for v in values if v is not None]
@@ -216,6 +342,39 @@ class Min(AggFunction):
     def finalize(self, bufs):
         b, = bufs
         return b.data, b.validity, b.lengths
+
+    def _global(self, col):
+        if col.lengths is not None:
+            return None       # string min/max: sorted path
+        v, val = col.data, col.validity
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            isnan = jnp.isnan(v)
+            real = val & ~isnan
+            nanv = jnp.asarray(jnp.nan, v.dtype)
+            if self.kind == "min":
+                m = jnp.min(jnp.where(real, v,
+                                      jnp.asarray(jnp.inf, v.dtype)))
+                m = jnp.where(jnp.sum(real.astype(jnp.int32)) > 0, m, nanv)
+            else:
+                m = jnp.max(jnp.where(real, v,
+                                      jnp.asarray(-jnp.inf, v.dtype)))
+                m = jnp.where(jnp.sum((val & isnan).astype(jnp.int32)) > 0,
+                              nanv, m)
+        else:
+            ident = kernels._identity_for(v.dtype, self.kind)
+            masked = jnp.where(val, v, ident)
+            m = jnp.min(masked) if self.kind == "min" else jnp.max(masked)
+        ok = jnp.sum(val.astype(jnp.int32)) > 0
+        return [(m, ok, None)]
+
+    def update_global(self, col, row_index=None, live=None):
+        return self._global(col)
+
+    def update_row(self, col, row_index):
+        return [(col.data, col.validity, col.lengths)]
+
+    def merge_global(self, bufs):
+        return self._global(bufs[0])
 
     def host_update(self, values):
         vs = [v for v in values if v is not None]
@@ -271,6 +430,49 @@ class Average(AggFunction):
         sb, cb = bufs
         safe = jnp.where(cb.data > 0, cb.data, 1)
         return sb.data / safe.astype(jnp.float64), cb.data > 0, None
+
+    # -- fast paths ------------------------------------------------------
+    @staticmethod
+    def _f64_terms(v):
+        finite = jnp.isfinite(v)
+        return [("f64", jnp.where(finite, v, 0.0)),
+                ("i32", jnp.isnan(v).astype(jnp.int32)),
+                ("i32", (v == jnp.inf).astype(jnp.int32)),
+                ("i32", (v == -jnp.inf).astype(jnp.int32))]
+
+    def sum_terms_update(self, col):
+        masked = jnp.where(col.validity, col.data.astype(jnp.float64), 0.0)
+        return self._f64_terms(masked) + \
+            [("i32", col.validity.astype(jnp.int32))]
+
+    def sum_terms_merge(self, bufs):
+        sb, cb = bufs
+        return self._f64_terms(jnp.where(sb.validity, sb.data, 0.0)) + \
+            [("i64", jnp.where(cb.validity, cb.data, 0))]
+
+    def bufs_from_sums(self, sums, capacity):
+        s, nan, pinf, ninf, c = sums
+        s = _reapply_nonfinite(s, nan, pinf, ninf)
+        c = c.astype(jnp.int64)
+        return [(s, c > 0, None),
+                (c, jnp.ones((capacity,), jnp.bool_), None)]
+
+    def update_global(self, col, row_index=None, live=None):
+        s = jnp.sum(jnp.where(col.validity, col.data.astype(jnp.float64),
+                              0.0))
+        c = jnp.sum(col.validity.astype(jnp.int64))
+        return [(s, c > 0, None), (c, True, None)]
+
+    def update_row(self, col, row_index):
+        ones = jnp.ones_like(col.validity)
+        return [(col.data.astype(jnp.float64), col.validity, None),
+                (col.validity.astype(jnp.int64), ones, None)]
+
+    def merge_global(self, bufs):
+        sb, cb = bufs
+        s = jnp.sum(jnp.where(sb.validity, sb.data, 0.0))
+        c = jnp.sum(jnp.where(cb.validity, cb.data, 0))
+        return [(s, c > 0, None), (c, True, None)]
 
     def host_update(self, values):
         vs = [v for v in values if v is not None]
@@ -361,6 +563,54 @@ class First(AggFunction):
     def finalize(self, bufs):
         vcol, _ = bufs
         return vcol.data, vcol.validity, vcol.lengths
+
+    def update_row(self, col, row_index):
+        eligible = col.validity if self.ignore_nulls else \
+            jnp.ones_like(col.validity)
+        bad = jnp.int64(2 ** 62 if self.pick == "min" else -1)
+        idx = jnp.where(eligible, row_index.astype(jnp.int64), bad)
+        return [(col.data, col.validity, col.lengths),
+                (idx, eligible, None)]
+
+    def update_global(self, col, row_index=None, live=None):
+        cap = col.validity.shape[0]
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        # With ignore_nulls=False a NULL row still wins, but dead rows
+        # (padding / sel-deselected) never do.
+        eligible = col.validity if self.ignore_nulls else \
+            (live if live is not None else jnp.ones_like(col.validity))
+        if self.pick == "min":
+            picked = jnp.min(jnp.where(eligible, pos, cap))
+            ok = picked < cap
+        else:
+            picked = jnp.max(jnp.where(eligible, pos, -1))
+            ok = picked >= 0
+        safe = jnp.clip(picked, 0, cap - 1).astype(jnp.int32)
+        val = jnp.take(col.data, safe, axis=0)
+        gidx = jnp.take(row_index, safe, axis=0) \
+            if row_index is not None else picked
+        bad = jnp.int64(2 ** 62 if self.pick == "min" else -1)
+        length = jnp.take(col.lengths, safe, axis=0) \
+            if col.lengths is not None else None
+        vval = ok & jnp.take(col.validity, safe, axis=0)
+        return [(val, vval, length), (jnp.where(ok, gidx, bad), ok, None)]
+
+    def merge_global(self, bufs):
+        vcol, icol = bufs
+        cap = icol.validity.shape[0]
+        bad = jnp.int64(2 ** 62 if self.pick == "min" else -1)
+        keyed = jnp.where(icol.validity, icol.data, bad)
+        best = jnp.min(keyed) if self.pick == "min" else jnp.max(keyed)
+        row = jnp.min(jnp.where(icol.validity & (keyed == best),
+                                jnp.arange(cap, dtype=jnp.int64), cap))
+        ok = (row < cap) & (best != bad)
+        safe = jnp.clip(row, 0, cap - 1).astype(jnp.int32)
+        val = jnp.take(vcol.data, safe, axis=0)
+        length = jnp.take(vcol.lengths, safe, axis=0) \
+            if vcol.lengths is not None else None
+        iv = jnp.take(icol.data, safe, axis=0)
+        return [(val, ok & jnp.take(vcol.validity, safe, axis=0), length),
+                (jnp.where(ok, iv, bad), ok, None)]
 
     def host_update(self, values):
         seq = [(i, v) for i, v in enumerate(values)
@@ -461,7 +711,7 @@ class HashAggregateExec(Exec):
                 cols.append(as_device_column(spec.fn.child.eval(batch),
                                              batch))
                 ords.append(len(cols) - 1)
-        return DeviceBatch(tuple(cols), batch.num_rows), ords
+        return DeviceBatch(tuple(cols), batch.num_rows, sel=batch.sel), ords
 
     @staticmethod
     def _sorted_col(col: DeviceColumn, perm, slive) -> SortedCol:
@@ -485,49 +735,130 @@ class HashAggregateExec(Exec):
                          jnp.zeros((), bt.np_dtype))
         return DeviceColumn(bt, data, valid)
 
+    # -- sorted-path machinery ----------------------------------------------
+    def _group_sorted(self, work: DeviceBatch):
+        """Group + ONE packed gather of the whole batch to group-sorted
+        order (rowmove.py): per-column takes cost ~40-60ms each at 1M rows
+        on this chip; the packed 2D form moves every column at once."""
+        from spark_rapids_tpu.columnar.rowmove import gather_rows
+        g = kernels.group_ids(work, range(self._nkeys))
+        live = work.live_count()
+        sorted_b = gather_rows(work, g.perm, live)
+        slive = jnp.arange(work.capacity, dtype=jnp.int32) < live
+        return g, sorted_b, slive
+
+    @staticmethod
+    def _segment_sums(stacks, gid, slive, capacity):
+        """ALL group sums with one cumsum + boundary shift-diff per dtype
+        class. Values arrive pre-masked (dead/null rows contribute 0).
+        Groups are contiguous ascending runs of ``gid`` in sorted order, so
+        group g's sum = prefix(end_g) - prefix(end_{g-1})."""
+        idx = jnp.arange(capacity, dtype=jnp.int32)
+        nxt_gid = jnp.concatenate([gid[1:], gid[-1:]])
+        nxt_live = jnp.concatenate([slive[1:], jnp.zeros((1,), jnp.bool_)])
+        last = slive & ((idx == capacity - 1) | (nxt_gid != gid)
+                        | ~nxt_live)
+        ends = jnp.zeros((capacity,), jnp.int32).at[
+            jnp.where(last, gid, capacity)].set(idx, mode="drop")
+        out = {}
+        for cls, arrs in stacks.items():
+            M = jnp.stack(arrs, axis=1)
+            S = jnp.cumsum(M, axis=0)
+            Se = jnp.take(S, ends, axis=0)
+            out[cls] = jnp.concatenate([Se[:1], Se[1:] - Se[:-1]], axis=0)
+        return out
+
+    def _run_specs(self, spec_inputs, gid, slive, capacity, row_index):
+        """Shared spec-evaluation core: ``spec_inputs`` yields per spec
+        ("update", SortedCol) or ("merge", [SortedCol...]). Sum-decomposable
+        specs ride the stacked-cumsum path; the rest use their segment
+        kernels. Returns the flat buffer list (per spec, per buffer)."""
+        stacks: dict = {}
+        plans = []          # per spec: ("sum", [(cls, pos)...]) | ("raw", bufs)
+        for spec, (kind, arg) in zip(self.aggs, spec_inputs):
+            terms = spec.fn.sum_terms_update(arg) if kind == "update" \
+                else spec.fn.sum_terms_merge(arg)
+            if terms is not None:
+                slots = []
+                for cls, values in terms:
+                    stacks.setdefault(cls, []).append(values)
+                    slots.append((cls, len(stacks[cls]) - 1))
+                plans.append(("sum", slots))
+            elif kind == "update":
+                plans.append(("raw", spec.fn.update(arg, gid, capacity,
+                                                    row_index)))
+            else:
+                plans.append(("raw", spec.fn.merge(arg, gid, capacity)))
+        sums = self._segment_sums(stacks, gid, slive, capacity) \
+            if stacks else {}
+        out = []
+        for spec, plan in zip(self.aggs, plans):
+            if plan[0] == "sum":
+                vals = [sums[cls][:, pos] for cls, pos in plan[1]]
+                out.append(spec.fn.bufs_from_sums(vals, capacity))
+            else:
+                out.append(plan[1])
+        return out
+
+    def _assemble(self, work: DeviceBatch, g, all_bufs) -> DeviceBatch:
+        """Key columns at group leaders (one small packed gather) + buffer
+        columns -> the output buffer batch."""
+        from spark_rapids_tpu.columnar.rowmove import gather_rows
+        cap = work.capacity
+        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
+        out_cols: List[DeviceColumn] = []
+        if self._nkeys:
+            keys = gather_rows(work.select(range(self._nkeys)),
+                               g.group_leader, g.num_groups)
+            out_cols.extend(keys.columns)
+        for spec, bufs in zip(self.aggs, all_bufs):
+            for buf, bt in zip(bufs, spec.fn.buffer_types):
+                out_cols.append(self._buf_column(buf, bt, gmask))
+        return DeviceBatch(tuple(out_cols), g.num_groups)
+
+    def _sorted_view(self, sorted_b: DeviceBatch, ord_: int) -> SortedCol:
+        c = sorted_b.columns[ord_]
+        return SortedCol(c.data, c.validity, c.lengths)
+
     def _update_batch(self, batch: DeviceBatch,
                       offset: jnp.ndarray) -> DeviceBatch:
         """One input batch -> partial buffer batch. ``offset`` is the global
         arrival index of this batch's row 0 (orders First/Last across the
         stream)."""
         work, ords = self._project_inputs(batch)
+        if self._global_ok:
+            return self._global_stage(work, ords, offset, update=True)
         cap = work.capacity
-        g = kernels.group_ids(work, range(self._nkeys))
-        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
+        g, sorted_b, slive = self._group_sorted(work)
         row_index = offset.astype(jnp.int64) + g.perm.astype(jnp.int64)
-        out_cols: List[DeviceColumn] = []
-        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
-        for ki in range(self._nkeys):
-            out_cols.append(work.columns[ki].gather(g.group_leader, gmask))
+        inputs = []
         for spec, ord_ in zip(self.aggs, ords):
             if ord_ is None:
-                col = SortedCol(jnp.zeros((cap,), jnp.int64), slive)
+                inputs.append(("update",
+                               SortedCol(jnp.zeros((cap,), jnp.int64),
+                                         slive)))
             else:
-                col = self._sorted_col(work.columns[ord_], g.perm, slive)
-            bufs = spec.fn.update(col, g.group_of_sorted, cap, row_index)
-            for buf, bt in zip(bufs, spec.fn.buffer_types):
-                out_cols.append(self._buf_column(buf, bt, gmask))
-        return DeviceBatch(tuple(out_cols), g.num_groups)
+                inputs.append(("update", self._sorted_view(sorted_b, ord_)))
+        bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap,
+                               row_index)
+        return self._assemble(work, g, bufs)
 
     def _merge_batch(self, batch: DeviceBatch) -> DeviceBatch:
         """Merge a buffer batch (re-group by keys, merge buffers)."""
+        if self._global_ok:
+            return self._global_stage(batch, None, None, update=False)
         cap = batch.capacity
-        g = kernels.group_ids(batch, range(self._nkeys))
-        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
-        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
-        out_cols: List[DeviceColumn] = []
-        for ki in range(self._nkeys):
-            out_cols.append(batch.columns[ki].gather(g.group_leader, gmask))
+        g, sorted_b, slive = self._group_sorted(batch)
         ci = self._nkeys
+        inputs = []
         for spec in self.aggs:
             nbuf = len(spec.fn.buffer_types)
-            bufs = [self._sorted_col(batch.columns[ci + b], g.perm, slive)
-                    for b in range(nbuf)]
-            merged = spec.fn.merge(bufs, g.group_of_sorted, cap)
-            for buf, bt in zip(merged, spec.fn.buffer_types):
-                out_cols.append(self._buf_column(buf, bt, gmask))
+            inputs.append(("merge",
+                           [self._sorted_view(sorted_b, ci + b)
+                            for b in range(nbuf)]))
             ci += nbuf
-        return DeviceBatch(tuple(out_cols), g.num_groups)
+        bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap, None)
+        return self._assemble(batch, g, bufs)
 
     def _mixed_batch(self, batch: DeviceBatch) -> DeviceBatch:
         """Distinct combo stage: input [keys..., x, nd buffers...] with
@@ -535,29 +866,89 @@ class HashAggregateExec(Exec):
         update over x, others merge buffers. Output is the standard
         buffer layout [keys..., all buffers...]."""
         cap = batch.capacity
-        g = kernels.group_ids(batch, range(self._nkeys))
-        slive = jnp.take(batch.row_mask(), g.perm, axis=0)
-        gmask = jnp.arange(cap, dtype=jnp.int32) < g.num_groups
-        out_cols: List[DeviceColumn] = []
-        for ki in range(self._nkeys):
-            out_cols.append(batch.columns[ki].gather(g.group_leader, gmask))
+        g, sorted_b, slive = self._group_sorted(batch)
         x_ord = self._nkeys
         ci = self._nkeys + 1            # nd buffers follow the x column
         row_index = g.perm.astype(jnp.int64)
+        inputs = []
         for spec in self.aggs:
             if spec.distinct:
-                col = self._sorted_col(batch.columns[x_ord], g.perm, slive)
-                bufs = spec.fn.update(col, g.group_of_sorted, cap,
-                                      row_index)
+                inputs.append(("update", self._sorted_view(sorted_b,
+                                                           x_ord)))
             else:
                 nbuf = len(spec.fn.buffer_types)
-                ins = [self._sorted_col(batch.columns[ci + b], g.perm,
-                                        slive) for b in range(nbuf)]
-                bufs = spec.fn.merge(ins, g.group_of_sorted, cap)
+                inputs.append(("merge",
+                               [self._sorted_view(sorted_b, ci + b)
+                                for b in range(nbuf)]))
                 ci += nbuf
-            for buf, bt in zip(bufs, spec.fn.buffer_types):
-                out_cols.append(self._buf_column(buf, bt, gmask))
-        return DeviceBatch(tuple(out_cols), g.num_groups)
+        bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap,
+                               row_index)
+        return self._assemble(batch, g, bufs)
+
+    # -- zero-key fast path ---------------------------------------------------
+    @property
+    def _global_ok(self) -> bool:
+        """Zero grouping keys and every fn supports whole-batch masked
+        reductions (no sort, no segment scatters — a 1M-row f64 masked sum
+        costs ~46ms vs ~700ms through the sorted path on this chip)."""
+        if self._nkeys != 0 or self.mode == "mixed_final":
+            return False
+        for spec in self.aggs:
+            fn = spec.fn
+            if isinstance(fn, Min) and fn.child.data_type().is_string:
+                return False
+        return True
+
+    def _global_stage(self, work: DeviceBatch, ords, offset,
+                      update: bool) -> DeviceBatch:
+        live = work.row_mask()
+        all_bufs = []
+        if update:
+            cap = work.capacity
+            row_index = offset.astype(jnp.int64) + \
+                jnp.arange(cap, dtype=jnp.int64)
+            for spec, ord_ in zip(self.aggs, ords):
+                if ord_ is None:
+                    col = SortedCol(jnp.zeros((cap,), jnp.int64), live)
+                else:
+                    c = work.columns[ord_]
+                    col = SortedCol(c.data, c.validity & live, c.lengths)
+                all_bufs.append(spec.fn.update_global(col, row_index,
+                                                      live=live))
+        else:
+            ci = self._nkeys
+            for spec in self.aggs:
+                nbuf = len(spec.fn.buffer_types)
+                bufs = []
+                for b in range(nbuf):
+                    c = work.columns[ci + b]
+                    bufs.append(SortedCol(c.data, c.validity & live,
+                                          c.lengths))
+                ci += nbuf
+                all_bufs.append(spec.fn.merge_global(bufs))
+        return self._global_assemble(all_bufs)
+
+    def _global_assemble(self, all_bufs) -> DeviceBatch:
+        cap = 8
+        first = jnp.arange(cap, dtype=jnp.int32) < 1
+        out_cols: List[DeviceColumn] = []
+        for spec, bufs in zip(self.aggs, all_bufs):
+            for (val, ok, length), bt in zip(bufs, spec.fn.buffer_types):
+                valid = first & jnp.asarray(ok, jnp.bool_)
+                if bt.is_string:
+                    w = val.shape[-1]
+                    data = jnp.zeros((cap, w), jnp.uint8).at[0].set(
+                        val.astype(jnp.uint8))
+                    lens = jnp.zeros((cap,), jnp.int32).at[0].set(
+                        jnp.asarray(length, jnp.int32))
+                    out_cols.append(self._buf_column((data, valid, lens),
+                                                     bt, first))
+                else:
+                    data = jnp.zeros((cap,), bt.np_dtype).at[0].set(
+                        jnp.asarray(val).astype(bt.np_dtype))
+                    out_cols.append(self._buf_column((data, valid, None),
+                                                     bt, first))
+        return DeviceBatch(tuple(out_cols), jnp.asarray(1, jnp.int32))
 
     def _finalize_batch(self, batch: DeviceBatch) -> DeviceBatch:
         out_cols = list(batch.columns[:self._nkeys])
@@ -575,6 +966,34 @@ class HashAggregateExec(Exec):
             ci += nbuf
         return DeviceBatch(tuple(out_cols), batch.num_rows)
 
+    def _passthrough_batch(self, batch: DeviceBatch,
+                           offset: jnp.ndarray) -> DeviceBatch:
+        """Partial-skip path: project each ROW into the buffer layout with
+        no grouping at all (pure elementwise — the measured reduction ratio
+        said grouping here would not pay for itself)."""
+        work, ords = self._project_inputs(batch)
+        cap = work.capacity
+        live = work.row_mask()
+        row_index = offset.astype(jnp.int64) + \
+            jnp.arange(cap, dtype=jnp.int64)
+        out_cols = list(work.columns[:self._nkeys])
+        for spec, ord_ in zip(self.aggs, ords):
+            if ord_ is None:
+                col = SortedCol(jnp.zeros((cap,), jnp.int64), live)
+            else:
+                c = work.columns[ord_]
+                col = SortedCol(c.data, c.validity & live, c.lengths)
+            bufs = spec.fn.update_row(col, row_index)
+            for buf, bt in zip(bufs, spec.fn.buffer_types):
+                out_cols.append(self._buf_column(buf, bt, live))
+        return DeviceBatch(tuple(out_cols), work.num_rows, sel=work.sel)
+
+    @property
+    def _rowskip_capable(self) -> bool:
+        return self._nkeys > 0 and all(
+            type(s.fn).update_row is not AggFunction.update_row
+            for s in self.aggs)
+
     def _jits(self):
         """One jit wrapper per exec instance — jax caches compiled programs
         on the wrapper, so partitions and repeated collects reuse them."""
@@ -582,62 +1001,124 @@ class HashAggregateExec(Exec):
             self._jit_fns = (jax.jit(self._update_batch),
                              jax.jit(self._merge_batch),
                              jax.jit(self._finalize_batch),
-                             jax.jit(self._mixed_batch))
+                             jax.jit(self._mixed_batch),
+                             jax.jit(self._passthrough_batch))
         return self._jit_fns
 
-    def execute_device(self, ctx, partition):
-        m = ctx.metrics_for(self)
-        update, merge, finalize, mixed = self._jits()
+    def _consolidate(self, ctx, m, pending: List[DeviceBatch],
+                     final_stage: bool = False) -> DeviceBatch:
+        """Shrink + concat + single merge over the pending list.
 
-        from spark_rapids_tpu import config as C
+        ONE batched sizes pull covers every hint-less batch (a sync is a
+        full network round trip on a tunneled chip; exchange pieces carry
+        ``rows_hint`` so the final stage usually needs no sync at all),
+        then everything merges in one grouped pass instead of the
+        per-batch re-merge loop (which cost O(batches × accumulated size)
+        device time)."""
+        import jax as _jax
         from spark_rapids_tpu.columnar.batch import (
             jit_concat_batches, shrink_to_capacity)
-        acc: Optional[DeviceBatch] = None
+        _, merge, finalize, mixed, _pt = self._jits()
+        counts = [b.rows_hint for b in pending]
+        unknown = [i for i, c in enumerate(counts) if c is None]
+        if unknown:
+            with timed(m, "sizesPullTime"):
+                pulled = _jax.device_get(
+                    [pending[i].live_count() for i in unknown])
+            for i, c in zip(unknown, pulled):
+                counts[i] = int(c)
+        shrunk = [shrink_to_capacity(b, bucket_capacity(max(c, 1)))
+                  for b, c in zip(pending, counts)]
+        if len(shrunk) > 1:
+            cap = bucket_capacity(sum(b.capacity for b in shrunk))
+            single = jit_concat_batches(shrunk, cap)
+        else:
+            single = shrunk[0]
+        # Raw-input modes always need their grouping stage; update-stage
+        # partials only when several were concatenated together.
+        if self.mode == "mixed_final":
+            single = mixed(single)
+        elif self.mode in ("final", "merge") or len(pending) > 1:
+            single = merge(single)
+        if final_stage and self.mode in ("final", "complete",
+                                         "mixed_final"):
+            single = finalize(single)
+        return single
+
+    def execute_device(self, ctx, partition):
+        import jax as _jax
+        m = ctx.metrics_for(self)
+        update, merge, finalize, mixed, passthrough = self._jits()
+
+        from spark_rapids_tpu import config as C
+        pending: List[DeviceBatch] = []
+        pending_cap = 0
         saw_input = False
         offset = 0
-        # Shrinking the accumulator to its true group-count bucket needs a
-        # device->host sync of the group count — on a remote/tunneled chip
-        # that is a full network round trip, so do it only when the
-        # accumulator's capacity has grown past a threshold (and once at
-        # the end) instead of per input batch. High-cardinality groupbys
-        # degrade gracefully: the threshold trips every batch and behavior
-        # matches the reference's per-batch re-merge (aggregate.scala:427).
-        shrink_at = 2 * int(ctx.conf.get(C.BATCH_SIZE_ROWS))
+        update_stage = self.mode in ("partial", "complete")
+        # Adaptive partial-skip (skipAggPassReductionRatio): measure the
+        # FIRST partial batch's reduction; if grouping barely reduced it,
+        # later batches project rows straight into the buffer layout and
+        # the post-exchange stage does all grouping once. One decision per
+        # query (cached in ctx), one small device sync to make it.
+        skip_key = f"aggskip:{id(self):x}"
+        skip_ratio = float(ctx.conf.get(C.AGG_SKIP_PARTIAL_RATIO))
+        can_skip = (self.mode == "partial" and skip_ratio < 1.0
+                    and self._rowskip_capable)
+        # Memory guard: when buffered partials exceed this many rows of
+        # capacity, consolidate early (mirrors the reference's iterative
+        # re-merge loop, aggregate.scala:427 — but amortized, not
+        # per-batch).
+        consolidate_at = 8 * int(ctx.conf.get(C.BATCH_SIZE_ROWS))
         for batch in self.children[0].execute_device(ctx, partition):
             saw_input = True
-            with timed(m):
-                # 'final'/'merge' consume buffer batches: first pass is a
-                # merge; 'mixed_final' runs the distinct combo kernel.
-                if self.mode in ("final", "merge"):
-                    partial = merge(batch)
-                elif self.mode == "mixed_final":
-                    partial = mixed(batch)
-                else:
-                    partial = update(batch, jnp.asarray(offset, jnp.int64))
+            if update_stage:
+                skipping = can_skip and ctx.cache.get(skip_key, False)
+                with timed(m):
+                    if skipping:
+                        partial = passthrough(
+                            batch, jnp.asarray(offset, jnp.int64))
+                    else:
+                        partial = update(
+                            batch, jnp.asarray(offset, jnp.int64))
+                if can_skip and skip_key not in ctx.cache:
+                    groups, live = _jax.device_get(
+                        [partial.num_rows, batch.live_count()])
+                    ctx.cache[skip_key] = \
+                        int(groups) >= skip_ratio * max(int(live), 1)
                 offset += batch.capacity
-                if acc is None:
-                    acc = partial
-                else:
-                    cap = bucket_capacity(acc.capacity + partial.capacity)
-                    acc = merge(jit_concat_batches([acc, partial], cap))
-                if acc.capacity > shrink_at:
-                    k = max(int(acc.num_rows), 1)
-                    acc = shrink_to_capacity(acc, bucket_capacity(k))
-        if not saw_input or acc is None:
+                if self.mode == "partial":
+                    # Partial stage feeds an exchange, which batches its
+                    # own sizes pull across every partition — emit the
+                    # per-batch partial as-is, no sync here.
+                    m.add("numOutputBatches", 1)
+                    yield partial
+                    continue
+                pending.append(partial)
+                pending_cap += partial.capacity
+            else:
+                # final/merge/mixed_final: defer ALL grouping to one
+                # consolidated pass over the partition's batches.
+                pending.append(batch)
+                pending_cap += batch.capacity
+            # mixed_final's kernel is NOT idempotent (it reads a raw x
+            # column that its own output no longer has) — never consolidate
+            # it mid-stream, only once at the end.
+            if pending_cap > consolidate_at and len(pending) > 1 \
+                    and self.mode != "mixed_final":
+                with timed(m):
+                    merged = self._consolidate(ctx, m, pending)
+                pending = [merged]
+                pending_cap = merged.capacity
+        if self.mode == "partial":
+            return
+        if not saw_input:
             if self._nkeys == 0 and self.mode in ("final", "complete",
                                                   "mixed_final"):
                 yield self._empty_result()
             return
         with timed(m):
-            if self.mode in ("final", "complete", "mixed_final"):
-                acc = finalize(acc)
-            # No per-partition shrink sync here: the group-count read is a
-            # device->host round trip, so whoever needs live-scale batches
-            # does it batched — exchanges shrink all child partitions with
-            # one sizes pull (two-phase exchange, SURVEY §7) and collect's
-            # download_batches shrinks before fetching. Downstream device
-            # ops just run at input capacity (compute is cheap; the link
-            # is not).
+            acc = self._consolidate(ctx, m, pending, final_stage=True)
         m.add("numOutputBatches", 1)
         yield acc
 

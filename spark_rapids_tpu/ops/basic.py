@@ -153,8 +153,13 @@ class ProjectExec(Exec):
 
 
 class FilterExec(Exec):
-    """Row filter via compaction (GpuFilterExec; cuDF Table.filter analog —
-    here compact() packs kept rows to the front, keeping capacity static)."""
+    """Row filter via SELECTION VECTOR (GpuFilterExec analog).
+
+    Rows are never moved: the condition mask ANDs into the batch's ``sel``
+    (batch.py), costing one fused elementwise kernel instead of a packed
+    compaction (~100-400ms/1M rows on the target chip). Downstream
+    operators read liveness through ``row_mask()``; materialization
+    happens at exchanges/concats/downloads."""
 
     def __init__(self, child: Exec, condition: Expression):
         super().__init__(child)
@@ -168,7 +173,7 @@ class FilterExec(Exec):
     def _kernel(self, batch: DeviceBatch) -> DeviceBatch:
         cond = as_device_column(self.condition.eval(batch), batch)
         keep = cond.data & cond.validity
-        return batch.compact(keep)
+        return batch.with_sel(keep)
 
     def _host_kernel(self, hb: HostBatch) -> HostBatch:
         cond = as_host_column(self.condition.eval_host(hb), hb)
@@ -330,9 +335,10 @@ class LocalLimitExec(Exec):
             if remaining <= 0:
                 break
             out = batch.head(remaining)
-            # num_rows is a device scalar; pull it once per batch to advance
-            # the python-side budget (same sync the reference does for limits)
-            taken = int(out.num_rows)
+            # live count is a device scalar; pull it once per batch to
+            # advance the python-side budget (the sync the reference's
+            # limit also does)
+            taken = int(out.live_count())
             remaining -= taken
             yield out
 

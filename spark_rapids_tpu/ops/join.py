@@ -55,7 +55,15 @@ class BuiltSide:
 
     Registered as a jax pytree so whole probe steps can be jitted with the
     built side passed as a traced argument (one compile serves every
-    partition)."""
+    partition).
+
+    ``stats`` is a small int64 device vector pulled to the host ONCE per
+    build (one round trip): [max_run, int_keys_ok, kmin..., kmax...].
+    It powers both the FK fast path (max_run bounds the output size with
+    no per-probe-batch sync) and the dense direct-address table decision.
+    ``table`` (set lazily) maps dense key offsets -> build row index — the
+    TPU-first replacement for a hash-table probe: one gather instead of a
+    double binary search (which costs ~190ms/1M probes on this chip)."""
 
     batch: DeviceBatch          # rows in fingerprint-sorted order
     fp: jnp.ndarray             # (cap,) uint64 sorted fingerprints
@@ -64,27 +72,28 @@ class BuiltSide:
     num_rows: jnp.ndarray       # int32
     key_ordinals: Optional[List[int]] = None  # for post-match verification
     null_safe: bool = False
-    # Max matchable rows sharing one fingerprint (device scalar). Synced
-    # once per build: when small, any probe batch's join output fits in
-    # probe_cap * max_run, so the per-probe-batch output-size sync (the
-    # cuDF join size computation) is skipped entirely — the FK-join fast
-    # path. None for nested-loop builds.
-    max_run: Optional[jnp.ndarray] = None
+    max_run: Optional[jnp.ndarray] = None     # kept for mesh path compat
+    stats: Optional[jnp.ndarray] = None       # int64 stats vector
+    table: Optional[jnp.ndarray] = None       # dense key -> row, or None
+    table_base: Optional[Tuple[int, ...]] = None   # kmin per key (host)
+    table_spans: Optional[Tuple[int, ...]] = None  # span per key (host)
 
 
 def _builtside_flatten(bs: "BuiltSide"):
     children = (bs.batch, bs.fp, bs.matchable, bs.row_live, bs.num_rows,
-                bs.max_run)
+                bs.max_run, bs.stats, bs.table)
     aux = (tuple(bs.key_ordinals) if bs.key_ordinals is not None else None,
-           bs.null_safe)
+           bs.null_safe, bs.table_base, bs.table_spans)
     return children, aux
 
 
 def _builtside_unflatten(aux, children):
-    ko, ns = aux
-    batch, fp, matchable, row_live, num_rows, max_run = children
+    ko, ns, tb, tsp = aux
+    batch, fp, matchable, row_live, num_rows, max_run, stats, table = \
+        children
     return BuiltSide(batch, fp, matchable, row_live, num_rows,
-                     list(ko) if ko is not None else None, ns, max_run)
+                     list(ko) if ko is not None else None, ns, max_run,
+                     stats, table, tb, tsp)
 
 
 jax.tree_util.register_pytree_node(
@@ -101,6 +110,7 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
                null_safe: bool = False) -> BuiltSide:
     """Sort build rows by fingerprint. Rows with null keys never match (SQL
     equi-join), but stay alive for full-outer emission."""
+    from spark_rapids_tpu.columnar.rowmove import gather_rows
     fp = _fingerprint64(batch, key_ordinals)
     row_live = batch.row_mask()
     matchable = row_live
@@ -108,15 +118,14 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
         for i in key_ordinals:
             matchable = matchable & batch.columns[i].validity
     # Unmatchable rows sort to the end with the max fingerprint sentinel
-    # (padding after null-key rows). Columns are gathered manually (not
-    # batch.gather) because liveness is per-sorted-row, not a prefix.
+    # (padding after null-key rows). One packed gather moves every column
+    # (rowmove.py); liveness is per-sorted-row, not a prefix.
     sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF)
     key = jnp.where(matchable, fp, sentinel)
     perm = jnp.argsort(key, stable=True)
     s_live = jnp.take(row_live, perm, axis=0)
-    cols = tuple(c.gather(perm.astype(jnp.int32), s_live)
-                 for c in batch.columns)
-    sorted_batch = DeviceBatch(cols, batch.num_rows)
+    sorted_batch = gather_rows(batch, perm.astype(jnp.int32),
+                               batch.num_rows, valid_dst=s_live)
     s_fp = jnp.take(key, perm, axis=0)
     s_match = jnp.take(matchable, perm, axis=0)
     # Longest run of equal sorted fingerprints among matchable rows (the
@@ -129,16 +138,91 @@ def build_side(batch: DeviceBatch, key_ordinals: Sequence[int],
         jnp.maximum, jnp.where(starts, idx, 0))
     run_pos = idx - last_start
     max_run = jnp.max(jnp.where(s_match, run_pos + 1, 0))
-    # Start the device->host copy of the fast-path bound now: the stream
-    # loop reads it before the first probe batch, and overlapping the pull
-    # with probe-side startup hides a full link round trip.
-    try:
-        max_run.copy_to_host_async()
-    except AttributeError:      # tracer (jit) context: no-op
-        pass
+    # Key range stats for the dense direct-address decision: all-integral
+    # keys with a small combined span get a direct table (gather probe).
+    int_ok = not null_safe
+    mins: List[jnp.ndarray] = []
+    maxs: List[jnp.ndarray] = []
+    for i in key_ordinals:
+        c = batch.columns[i]
+        if not (c.dtype.is_integral or c.dtype.name == "date"):
+            int_ok = False
+            break
+        v = c.data.astype(jnp.int64)
+        ok = matchable & c.validity
+        mins.append(jnp.min(jnp.where(ok, v, jnp.int64(2 ** 62))))
+        maxs.append(jnp.max(jnp.where(ok, v, jnp.int64(-2 ** 62))))
+    if not int_ok:
+        mins, maxs = [], []
+    stats = jnp.stack([max_run.astype(jnp.int64),
+                       jnp.asarray(1 if int_ok else 0, jnp.int64)]
+                      + mins + maxs) if key_ordinals else None
+    # Start the device->host copy of the stats now: the stream loop reads
+    # them before the first probe batch, and overlapping the pull with
+    # probe-side startup hides a full link round trip.
+    if stats is not None:
+        try:
+            stats.copy_to_host_async()
+        except AttributeError:      # tracer (jit) context: no-op
+            pass
     return BuiltSide(sorted_batch, s_fp, s_match, s_live,
                      batch.num_rows, list(key_ordinals), null_safe,
-                     max_run)
+                     max_run, stats)
+
+
+# Dense tables beyond this many entries are not worth the HBM (64 MB int32)
+_DENSE_TABLE_MAX = 1 << 24
+
+
+def _maybe_build_dense(built: BuiltSide, batch: DeviceBatch,
+                       key_ordinals: Sequence[int]) -> None:
+    """Attach a direct-address table when the (integral) build keys are
+    unique and span a small dense range — every TPC-style FK dimension
+    join qualifies. Probe then costs ONE gather + compare instead of a
+    sorted binary search + expansion. Idempotent: a broadcast BuiltSide is
+    shared across probe partitions and must build its table once."""
+    if built.stats is None or built.table is not None:
+        return
+    st = [int(x) for x in np.asarray(built.stats)]
+    max_run, int_ok = st[0], st[1]
+    if not int_ok or max_run > 1:
+        return
+    k = len(key_ordinals)
+    mins, maxs = st[2:2 + k], st[2 + k:2 + 2 * k]
+    if any(mx < mn for mn, mx in zip(mins, maxs)):
+        return          # no matchable rows
+    spans = [mx - mn + 1 for mn, mx in zip(mins, maxs)]
+    total = 1
+    for s in spans:
+        total *= s
+        if total > _DENSE_TABLE_MAX:
+            return
+    size = 1
+    while size < total:
+        size *= 2
+    fn = _DENSE_BUILD_JITS.get(size)
+    if fn is None:
+        def build_table(batch_, matchable, mins_, spans_, ords):
+            combined = jnp.zeros((batch_.capacity,), jnp.int64)
+            for i, o in enumerate(ords):
+                v = batch_.columns[o].data.astype(jnp.int64) - mins_[i]
+                combined = combined * spans_[i] + v
+            pos = jnp.where(matchable, combined, size)
+            rows = jnp.arange(batch_.capacity, dtype=jnp.int32)
+            return jnp.full((size,), -1, jnp.int32).at[pos].set(
+                rows, mode="drop")
+        fn = jax.jit(build_table, static_argnames=("ords",))
+        _DENSE_BUILD_JITS[size] = fn
+    # The table indexes the fingerprint-SORTED batch (built.batch) — the
+    # same rows every other join path gathers from.
+    built.table = fn(built.batch, built.matchable,
+                     jnp.asarray(mins, jnp.int64),
+                     jnp.asarray(spans, jnp.int64), tuple(key_ordinals))
+    built.table_base = tuple(mins)
+    built.table_spans = tuple(spans)
+
+
+_DENSE_BUILD_JITS: dict = {}
 
 
 def _pair_keys_equal(built: BuiltSide, b_idx: jnp.ndarray,
@@ -284,6 +368,71 @@ class _JoinKernelMixin:
                                        "probe_keys"))
         return self._emit_jit
 
+    def _dense_step(self, built: BuiltSide, pbatch: DeviceBatch,
+                    probe_keys, build_is_right: bool):
+        """Direct-address probe: ONE table gather decides every probe row's
+        build match (unique integral build keys — the FK dimension join).
+        Emits a selection-vector batch: no expansion, no output-size sync,
+        no compaction. ~45ms per 1M-row probe batch on this chip vs ~1.2s
+        through the sorted-search path."""
+        from spark_rapids_tpu.columnar.rowmove import gather_rows
+        jt = self.join_type
+        cond = self.condition
+        base, spans = built.table_base, built.table_spans
+        size = built.table.shape[0]
+        plive = pbatch.row_mask()
+        combined = jnp.zeros((pbatch.capacity,), jnp.int64)
+        inrange = plive
+        for i, o in enumerate(probe_keys):
+            c = pbatch.columns[o]
+            v = c.data.astype(jnp.int64)
+            inrange = inrange & c.validity & (v >= base[i]) & \
+                (v < base[i] + spans[i])
+            combined = combined * spans[i] + (v - base[i])
+        idx = jnp.clip(combined, 0, size - 1)
+        pos = jnp.take(built.table, idx, axis=0)
+        found = inrange & (pos >= 0)
+        if jt in ("semi", "anti") and cond is None:
+            keep = found if jt == "semi" else ~found
+            return pbatch.with_sel(keep & plive)
+        bsafe = jnp.clip(pos, 0, built.batch.capacity - 1)
+        build_out = gather_rows(built.batch, bsafe, pbatch.num_rows,
+                                valid_dst=found)
+        if build_is_right:
+            cols = tuple(pbatch.columns) + tuple(build_out.columns)
+        else:
+            cols = tuple(build_out.columns) + tuple(pbatch.columns)
+        pairs = DeviceBatch(cols, pbatch.num_rows)
+        matched = found
+        if cond is not None:
+            c = as_device_column(cond.eval(pairs), pairs)
+            matched = matched & c.data & c.validity
+        if jt == "inner":
+            return pairs.with_sel(matched & plive)
+        if jt in ("semi", "anti"):
+            keep = matched if jt == "semi" else ~matched
+            return pbatch.with_sel(keep & plive)
+        # left/right outer: every live probe row survives; the build side
+        # shows NULLs where unmatched (gather valid_dst already nulled
+        # not-found rows; a failed condition re-nulls here).
+        if cond is not None:
+            nulled = tuple(
+                c.with_validity(c.validity & matched)
+                for c in build_out.columns)
+            if build_is_right:
+                cols = tuple(pbatch.columns) + nulled
+            else:
+                cols = nulled + tuple(pbatch.columns)
+            pairs = DeviceBatch(cols, pbatch.num_rows)
+        return pairs.with_sel(plive)
+
+    def _dense_jit_fn(self):
+        if getattr(self, "_dense_jit", None) is None:
+            self._dense_jit = jax.jit(
+                self._dense_step,
+                static_argnames=("probe_keys", "build_is_right"))
+        return self._dense_jit
+
     def _device_join_stream(self, ctx, built: BuiltSide, probe_iter,
                             probe_keys, build_is_right: bool):
         jt = self.join_type
@@ -293,12 +442,25 @@ class _JoinKernelMixin:
         # stream and unmatched build rows are emitted once at the end.
         covered_acc = jnp.zeros((build_cap,), jnp.bool_) \
             if jt == "full" else None
-        # One sync per BUILD (not per probe batch): FK-style joins
-        # (unique/near-unique build keys) size every probe batch's output
-        # as probe_cap * max_run with no further syncs.
-        mr = int(built.max_run) if built.max_run is not None else None
-        fast = mr is not None and 0 < mr <= self._FAST_PATH_MAX_RUN
+        # One sync per BUILD (not per probe batch): the stats pull powers
+        # both the FK fast path (max_run sizes every probe batch's output
+        # with no further syncs) and the dense direct-address table.
         jittable = cond is None or getattr(cond, "jittable", False)
+        mr = None
+        if built.stats is not None:
+            mr = int(np.asarray(built.stats)[0])
+        elif built.max_run is not None:
+            mr = int(built.max_run)
+        if mr is not None and jt in ("inner", "left", "right", "semi",
+                                     "anti") and jittable:
+            _maybe_build_dense(built, built.batch, built.key_ordinals)
+        if built.table is not None:
+            dense = self._dense_jit_fn()
+            for pbatch in probe_iter:
+                yield dense(built, pbatch, probe_keys=tuple(probe_keys),
+                            build_is_right=build_is_right)
+            return
+        fast = mr is not None and 0 < mr <= self._FAST_PATH_MAX_RUN
         for pbatch in probe_iter:
             if fast:
                 out_cap = bucket_capacity(max(pbatch.capacity * mr, 1))
@@ -351,6 +513,7 @@ class _JoinKernelMixin:
                        build_is_right: bool, probe_keys=None):
         """Expand matches for one probe batch. Returns (out_batch,
         covered_build_rows_or_None)."""
+        from spark_rapids_tpu.columnar.rowmove import gather_rows
         jt = self.join_type
         cond = self.condition
         probe_cap = pbatch.capacity
@@ -358,13 +521,13 @@ class _JoinKernelMixin:
                                                      probe_cap)
         if built.key_ordinals is not None and probe_keys is not None:
             valid = _pair_keys_equal(built, b, pbatch, p, probe_keys, valid)
-        probe_cols = _gather_cols(pbatch, p, valid)
-        build_cols = _gather_cols(built.batch, b, valid)
+        probe_out = gather_rows(pbatch, p, total, valid_dst=valid)
+        build_out = gather_rows(built.batch, b, total, valid_dst=valid)
         if build_is_right:
-            left_cols, right_cols = probe_cols, build_cols
+            cols = tuple(probe_out.columns) + tuple(build_out.columns)
         else:
-            left_cols, right_cols = build_cols, probe_cols
-        pairs = DeviceBatch(tuple(left_cols) + tuple(right_cols), total)
+            cols = tuple(build_out.columns) + tuple(probe_out.columns)
+        pairs = DeviceBatch(cols, total)
 
         if cond is not None:
             c = as_device_column(cond.eval(pairs), pairs)
@@ -373,14 +536,14 @@ class _JoinKernelMixin:
             cond_keep = valid
 
         if jt in ("inner", "cross"):
-            return pairs.compact(cond_keep), None
+            return pairs.with_sel(cond_keep), None
         if jt in ("semi", "anti"):
             hit = jax.ops.segment_max(
                 cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
             keep = (hit if jt == "semi" else ~hit) & pbatch.row_mask()
-            return pbatch.compact(keep), None
+            return pbatch.with_sel(keep), None
         # Outer joins: survivors + unmatched probe rows with NULL side.
-        survivors = pairs.compact(cond_keep)
+        survivors = pairs.with_sel(cond_keep)
         probe_hit = jax.ops.segment_max(
             cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
         probe_unmatched = ~probe_hit & pbatch.row_mask()
@@ -399,8 +562,8 @@ class _JoinKernelMixin:
 
     def _null_extend(self, pbatch: DeviceBatch, keep, built: BuiltSide,
                      build_is_right: bool) -> DeviceBatch:
-        """Probe rows with a NULL build side."""
-        kept = pbatch.compact(keep)
+        """Probe rows with a NULL build side (selection-vector, no move)."""
+        kept = pbatch.with_sel(keep & pbatch.row_mask())
         nulls = [DeviceColumn.full_null(
             c.dtype, kept.capacity,
             c.string_width if c.dtype.is_string else 8)
@@ -409,11 +572,17 @@ class _JoinKernelMixin:
             cols = tuple(kept.columns) + tuple(nulls)
         else:
             cols = tuple(nulls) + tuple(kept.columns)
-        return DeviceBatch(cols, kept.num_rows)
+        return DeviceBatch(cols, kept.num_rows, sel=kept.sel)
 
     def _null_extend_build(self, built: BuiltSide, keep, pbatch: DeviceBatch,
                            build_is_right: bool) -> DeviceBatch:
-        kept = built.batch.compact(keep)
+        # built.batch's live rows are NOT a prefix (fingerprint-sorted with
+        # null-key rows at the end): num_rows=capacity makes row_mask read
+        # the selection vector alone.
+        keep = keep & built.row_live
+        kept = DeviceBatch(built.batch.columns,
+                           jnp.asarray(built.batch.capacity, jnp.int32),
+                           sel=keep)
         nulls = [DeviceColumn.full_null(
             c.dtype, kept.capacity,
             c.string_width if c.dtype.is_string else 8)
@@ -422,7 +591,7 @@ class _JoinKernelMixin:
             cols = tuple(nulls) + tuple(kept.columns)
         else:
             cols = tuple(kept.columns) + tuple(nulls)
-        return DeviceBatch(cols, kept.num_rows)
+        return DeviceBatch(cols, kept.num_rows, sel=kept.sel)
 
 
 # ---------------------------------------------------------------------------
@@ -650,17 +819,17 @@ class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
                 (cond_keep & valid).astype(jnp.int32),
                 jnp.clip(b, 0, bcap - 1), num_segments=bcap) > 0
         if jt in ("inner", "cross"):
-            return pairs.compact(cond_keep), covered
+            return pairs.with_sel(cond_keep), covered
         if jt in ("semi", "anti"):
             hit = jax.ops.segment_max(
                 cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
             keep = (hit if jt == "semi" else ~hit) & pbatch.row_mask()
-            return pbatch.compact(keep), covered
+            return pbatch.with_sel(keep), covered
         if jt == "right":
             # Only matched pairs here; unmatched build rows come at end.
-            return pairs.compact(cond_keep), covered
+            return pairs.with_sel(cond_keep), covered
         # left / full: survivors + probe-unmatched null-extended.
-        survivors = pairs.compact(cond_keep)
+        survivors = pairs.with_sel(cond_keep)
         probe_hit = jax.ops.segment_max(
             cond_keep.astype(jnp.int32), p, num_segments=probe_cap) > 0
         probe_unmatched = ~probe_hit & pbatch.row_mask()

@@ -113,13 +113,14 @@ def sort_key_passes(col: DeviceColumn, ascending: bool,
     return [null_word] + words
 
 
-def lex_sort_perm(passes: List[jnp.ndarray], num_rows: jnp.ndarray,
+def lex_sort_perm(passes: List[jnp.ndarray], live: jnp.ndarray,
                   capacity: int) -> jnp.ndarray:
-    """Stable permutation sorting rows by the MSW-first word passes; padding
-    rows always sort last."""
-    pad_last = jnp.where(
-        jnp.arange(capacity, dtype=jnp.int32) < num_rows,
-        jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
+    """Stable permutation sorting rows by the MSW-first word passes; dead
+    rows (padding / deselected) always sort last. ``live`` is either a
+    (capacity,) bool mask (row_mask) or an int32 row-count scalar."""
+    if getattr(live, "ndim", 0) == 0 or np.isscalar(live):
+        live = jnp.arange(capacity, dtype=jnp.int32) < live
+    pad_last = jnp.where(live, jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
     perm = jnp.arange(capacity, dtype=jnp.int32)
     # LSD radix over words: apply stable argsort from least significant pass
     # to most significant; padding pass last (most significant of all).
@@ -150,10 +151,22 @@ def key_fingerprint(cols: Sequence[DeviceColumn],
     ha = jnp.full((capacity,), np.uint32(_SEED_A), dtype=jnp.uint32)
     hb = jnp.full((capacity,), np.uint32(_SEED_B), dtype=jnp.uint32)
     for i, c in enumerate(cols):
-        if c.dtype.is_floating:
+        # Null cells may carry arbitrary data (packed row movement does not
+        # zero them — rowmove.py contract); normalize so all NULLs
+        # fingerprint identically. The null flag itself is mixed below.
+        if c.dtype.is_string:
+            data = jnp.where(c.validity[:, None], c.data,
+                             jnp.zeros_like(c.data))
+            lens = jnp.where(c.validity, c.lengths, 0)
+            c = DeviceColumn(c.dtype, data, c.validity, lens)
+        elif c.dtype.is_floating:
             # Grouping equality: -0.0 == 0.0 and NaN == NaN (Spark inserts
             # NormalizeNaNAndZero before grouping; we fold it in here).
             data = jnp.where(c.data == 0, jnp.zeros_like(c.data), c.data)
+            data = jnp.where(c.validity, data, jnp.zeros_like(data))
+            c = DeviceColumn(c.dtype, data, c.validity)
+        else:
+            data = jnp.where(c.validity, c.data, jnp.zeros_like(c.data))
             c = DeviceColumn(c.dtype, data, c.validity)
         ha = mh.hash_column(jnp, c, c.dtype, ha)
         hb = mh.hash_column(jnp, c, c.dtype, hb)

@@ -47,14 +47,16 @@ def coalesce_to_single_batch(batches: List[DeviceBatch]) -> DeviceBatch:
 
 
 def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]) -> DeviceBatch:
-    """Device kernel: fully sort one batch by the sort orders."""
+    """Device kernel: fully sort one batch by the sort orders. Selected
+    (live) rows sort to the front, so the output is dense (sel discharged
+    by the gather)."""
     passes: List[jnp.ndarray] = []
     for o in orders:
         col = as_device_column(o.child.eval(batch), batch)
         passes.extend(kernels.sort_key_passes(col, o.ascending,
                                               o.nulls_first))
-    perm = kernels.lex_sort_perm(passes, batch.num_rows, batch.capacity)
-    return batch.gather(perm, batch.num_rows)
+    perm = kernels.lex_sort_perm(passes, batch.row_mask(), batch.capacity)
+    return batch.gather(perm, batch.live_count())
 
 
 class SortExec(Exec):

@@ -30,6 +30,25 @@ from spark_rapids_tpu.parallel.partitioning import (
     Partitioning, RangePartitioning, split_batch, split_host_batch)
 
 
+def _slice_rows(batch: DeviceBatch, start, size: int,
+                num_rows) -> DeviceBatch:
+    """Rows [start, start+size) of a dense batch as a new batch with
+    ``num_rows`` live rows (traced start/num_rows; static size)."""
+    from spark_rapids_tpu.columnar.batch import DeviceColumn
+    cols = []
+    for c in batch.columns:
+        data = jax.lax.dynamic_slice_in_dim(c.data, start, size, axis=0)
+        validity = jax.lax.dynamic_slice_in_dim(c.validity, start, size,
+                                                axis=0)
+        if c.dtype.is_string:
+            lengths = jax.lax.dynamic_slice_in_dim(c.lengths, start, size,
+                                                   axis=0)
+            cols.append(DeviceColumn(c.dtype, data, validity, lengths))
+        else:
+            cols.append(DeviceColumn(c.dtype, data, validity))
+    return DeviceBatch(tuple(cols), jnp.asarray(num_rows, jnp.int32))
+
+
 class ShuffleExchangeExec(Exec):
     """Repartition the child by a Partitioning strategy."""
 
@@ -104,6 +123,56 @@ class ShuffleExchangeExec(Exec):
         p.bounds = RangePartitioning.compute_bounds(
             merged, bound_orders, p.num_partitions)
 
+    def _pids_counts_fn(self):
+        """Jitted (pids, per-partition live counts) for one child batch."""
+        if getattr(self, "_pids_jit", None) is None:
+            n = self.partitioning.num_partitions
+
+            def fn(b: DeviceBatch):
+                pids = self.partitioning.partition_ids(b)
+                live = b.row_mask()
+                key = jnp.where(live, pids, n)
+                counts = jax.ops.segment_sum(
+                    jnp.ones((b.capacity,), jnp.int32), key,
+                    num_segments=n + 1)[:n]
+                return pids, counts
+            self._pids_jit = jax.jit(fn) \
+                if self.partitioning.jittable else fn
+        return self._pids_jit
+
+    def _split_fn(self, piece_cap: int):
+        """Jitted split: ONE pid-stable sort + ONE packed gather, then a
+        dynamic slice per piece — replaces the per-partition compaction
+        storm (contiguousSplit done the TPU way: gather/scatter cost on
+        this chip scales with row-operations, so moving all columns once
+        beats moving each partition separately ~n-fold)."""
+        key = ("split", piece_cap)
+        fn = self._JITS.get(key) if hasattr(self, "_JITS") else None
+        if not hasattr(self, "_JITS"):
+            self._JITS = {}
+        if fn is None:
+            n = self.partitioning.num_partitions
+
+            def fn(b: DeviceBatch, pids, offsets, counts):
+                from spark_rapids_tpu.columnar.rowmove import gather_rows
+                live = b.row_mask()
+                skey = jnp.where(live, pids, n)
+                perm = jnp.argsort(skey, stable=True)
+                # Pad the gather so a slice at offset near the end never
+                # clamps (dynamic_slice adjusts out-of-range starts).
+                idx = jnp.concatenate(
+                    [perm.astype(jnp.int32),
+                     jnp.zeros((piece_cap,), jnp.int32)])
+                sorted_b = gather_rows(b, idx, b.live_count())
+                pieces = []
+                for p in range(n):
+                    pieces.append(_slice_rows(sorted_b, offsets[p],
+                                              piece_cap, counts[p]))
+                return pieces
+            fn = jax.jit(fn) if self.partitioning.jittable else fn
+            self._JITS[key] = fn
+        return fn
+
     def _materialize_device(self, ctx) -> List[List[DeviceBatch]]:
         key = self._cache_key(True)
         if key in ctx.cache:
@@ -111,37 +180,43 @@ class ShuffleExchangeExec(Exec):
         self._ensure_bounds(ctx, device=True)
         n = self.partitioning.num_partitions
         buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
-        if self._split_jit is None:
-            split_fn = lambda b: split_batch(
-                b, self.partitioning.partition_ids(b), n)
-            self._split_jit = jax.jit(split_fn) \
-                if self.partitioning.jittable else split_fn
-        split = self._split_jit
         from spark_rapids_tpu.columnar.batch import shrink_to_capacity
         from spark_rapids_tpu.memory.stores import (
             PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
-        # Two-phase sizes-then-data (SURVEY §7): pull unknown row counts in
-        # a BATCHED device_get and shrink each batch to its live bucket
-        # before splitting. Partial aggregates and selective filters yield
-        # at input capacity; one batched sync per window replaces a
-        # per-partition sync. The window is bounded so pre-split batches
-        # never accumulate unboundedly in un-spillable HBM (a shuffle whose
-        # input exceeds device memory must be able to spill mid-shuffle).
+        pids_fn = self._pids_counts_fn()
+        # Two-phase sizes-then-data (SURVEY §7): dispatch per-batch
+        # partition-id counts, pull the whole window's counts in ONE
+        # batched device_get (a sync is a full network round trip on a
+        # tunneled chip), then split each batch with host-known piece
+        # sizes. The window is bounded so pre-split batches never
+        # accumulate unboundedly in un-spillable HBM.
         _WINDOW = 32
 
         def flush_window(window: List[DeviceBatch]):
-            counts = [b.rows_hint for b in window]
-            unknown = [i for i, c in enumerate(counts) if c is None]
-            if unknown:
-                pulled = jax.device_get(
-                    [window[i].num_rows for i in unknown])
-                for i, c in zip(unknown, pulled):
-                    counts[i] = int(c)
-            for batch, cnt in zip(window, counts):
-                batch = shrink_to_capacity(batch,
-                                           bucket_capacity(max(cnt, 1)))
-                pieces = split(batch)
+            metas = [(b,) + tuple(pids_fn(b)) for b in window]
+            pulled = jax.device_get([m[2] for m in metas])
+            for (batch, pids, _), counts in zip(metas, pulled):
+                counts = [int(c) for c in counts]
+                total = sum(counts)
+                if total == 0:
+                    continue
+                # Mostly-dead batches (selective filters, tiny partial
+                # aggregates) shrink to their live bucket first so the
+                # split's gather moves live rows, not capacity.
+                small = bucket_capacity(max(total, 1))
+                if small < batch.capacity:
+                    batch = shrink_to_capacity(batch, small)
+                    pids, _ = pids_fn(batch)
+                piece_cap = bucket_capacity(max(max(counts), 1))
+                offsets = np.concatenate(
+                    [[0], np.cumsum(counts[:-1])]).astype(np.int32)
+                pieces = self._split_fn(piece_cap)(
+                    batch, pids, jnp.asarray(offsets),
+                    jnp.asarray(counts, jnp.int32))
                 for p, piece in enumerate(pieces):
+                    if counts[p] == 0:
+                        continue
+                    piece.rows_hint = counts[p]
                     # Shuffle output is spillable (RapidsCachingWriter
                     # inserts into the device store; shuffle spills FIRST
                     # per SpillPriorities) — the bucket holds a handle,
@@ -210,6 +285,12 @@ class ShuffleExchangeExec(Exec):
             batches = [sb.get() for sb in sbs]
             cap = bucket_capacity(sum(b.capacity for b in batches))
             out = jit_concat_batches(batches, cap)
+            # Pieces carry exact live counts from the split's sizes pull;
+            # their sum lets the consumer (final aggregate, download) skip
+            # its own device sync entirely.
+            hints = [b.rows_hint for b in batches]
+            if all(h is not None for h in hints):
+                out.rows_hint = sum(hints)
             for sb in sbs:
                 sb.release(PRIORITY_SHUFFLE_OUTPUT)
             return out, []
@@ -280,7 +361,7 @@ class BroadcastExchangeExec(Exec):
         unknown = [i for i, c in enumerate(counts) if c is None]
         if unknown:
             pulled = jax.device_get(
-                [batches[i].num_rows for i in unknown])
+                [batches[i].live_count() for i in unknown])
             for i, c in zip(unknown, pulled):
                 counts[i] = int(c)
         batches = [shrink_to_capacity(b, bucket_capacity(max(c, 1)))

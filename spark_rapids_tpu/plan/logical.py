@@ -135,6 +135,12 @@ class Column:
     def desc(self) -> "Column":
         return Column(("sortorder", self, False, False))
 
+    def over(self, window: "WindowDef") -> "Column":
+        """Evaluate this aggregate/window function over a window
+        (pyspark ``Column.over``; ref GpuWindowExpression.scala)."""
+        assert isinstance(window, WindowDef), "over() takes a Window spec"
+        return Column(("window", self, window))
+
     @property
     def name_hint(self) -> str:
         n = self.node
@@ -466,6 +472,100 @@ def agg_last(c, ignore_nulls=True) -> Column:
 
 
 # ---------------------------------------------------------------------------
+# Window DSL (pyspark Window analog; ref GpuWindowExec.scala:92 /
+# GpuWindowExpression.scala frame envelope)
+# ---------------------------------------------------------------------------
+
+class WindowDef:
+    """A window specification: partitioning, ordering, and an optional
+    ROWS frame. Built via the ``Window`` builder, consumed by
+    ``Column.over``."""
+
+    def __init__(self, partition_cols=(), order_cols=(), frame=None):
+        self.partition_cols = tuple(partition_cols)
+        self.order_cols = tuple(order_cols)
+        self.frame = frame          # None | ("rows", start, end)
+
+    def partition_by(self, *cols) -> "WindowDef":
+        return WindowDef(tuple(_name_or_col(c) for c in cols),
+                         self.order_cols, self.frame)
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols) -> "WindowDef":
+        return WindowDef(self.partition_cols,
+                         tuple(_name_or_col(c) for c in cols), self.frame)
+
+    orderBy = order_by
+
+    def rows_between(self, start, end) -> "WindowDef":
+        """ROWS frame: ``start``/``end`` are row offsets relative to the
+        current row (negative = preceding); ``Window.unboundedPreceding``
+        / ``unboundedFollowing`` for unbounded ends."""
+        return WindowDef(self.partition_cols, self.order_cols,
+                         ("rows", start, end))
+
+    rowsBetween = rows_between
+
+
+def _name_or_col(c) -> "Column":
+    """Strings name COLUMNS here (pyspark Window semantics), unlike the
+    value-literal convention of expression operands."""
+    return col(c) if isinstance(c, str) else c
+
+
+class _WindowBuilder:
+    """Entry point mirroring ``pyspark.sql.Window``."""
+
+    unboundedPreceding = None
+    unboundedFollowing = None
+    currentRow = 0
+
+    @staticmethod
+    def partition_by(*cols) -> WindowDef:
+        return WindowDef().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols) -> WindowDef:
+        return WindowDef().order_by(*cols)
+
+    orderBy = order_by
+
+
+Window = _WindowBuilder
+
+
+def row_number() -> Column:
+    return Column(("winfn", "row_number", None, 0))
+
+
+def rank() -> Column:
+    return Column(("winfn", "rank", None, 0))
+
+
+def dense_rank() -> Column:
+    return Column(("winfn", "dense_rank", None, 0))
+
+
+def lead(c, offset: int = 1) -> Column:
+    return Column(("winfn", "lead", _as_col(c), offset))
+
+
+def lag(c, offset: int = 1) -> Column:
+    return Column(("winfn", "lag", _as_col(c), offset))
+
+
+def is_window_column(c: Column) -> bool:
+    """True when ``c`` is a window expression (possibly aliased)."""
+    node = c.node
+    while node[0] == "alias":
+        node = node[1].node
+    return node[0] == "window"
+
+
+# ---------------------------------------------------------------------------
 # Expression resolution (name -> ordinal, untyped -> typed)
 # ---------------------------------------------------------------------------
 
@@ -765,6 +865,46 @@ class LogicalAggregate(_Unary):
             fn = resolve_agg(c, self.child.schema)
             out.append((name, fn.result_type))
         return tuple(out)
+
+
+class LogicalWindow(_Unary):
+    """Appends ONE window-expression column to the child
+    (ExtractWindowExpressions analog: the DataFrame layer extracts window
+    columns out of select/with_column into a chain of these nodes; the
+    planner inserts the co-locating exchange underneath —
+    GpuWindowExec.scala:92 requiredChildDistribution)."""
+
+    def __init__(self, child, out_name: str, fn_col: Column,
+                 window: "WindowDef"):
+        super().__init__(child)
+        self.out_name = out_name
+        self.fn_col = fn_col            # ("winfn", ...) or ("agg", ...)
+        self.window = window
+
+    def result_type(self) -> DataType:
+        node = self.fn_col.node
+        if node[0] == "winfn":
+            kind = node[1]
+            if kind in ("row_number", "rank", "dense_rank"):
+                return dt.INT32
+            return resolve(node[2], self.child.schema).data_type()
+        if node[0] == "agg":
+            kind = node[1]
+            if kind == "count":
+                return dt.INT64
+            if kind == "avg":
+                return dt.FLOAT64
+            t = resolve(node[2], self.child.schema).data_type()
+            if kind == "sum":
+                return dt.FLOAT64 if t.is_floating else dt.INT64
+            return t
+        raise ResolutionError(
+            f"unsupported window function {node[0]!r}")
+
+    @property
+    def schema(self) -> Schema:
+        return tuple(self.child.schema) + \
+            ((self.out_name, self.result_type()),)
 
 
 class LogicalSort(_Unary):

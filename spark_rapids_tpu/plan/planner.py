@@ -243,6 +243,17 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
         for k in (plan.keys or []):
             _forbid_contextual(k, "repartition keys")
             tag_column(k, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalWindow):
+        for c in plan.window.partition_cols:
+            _forbid_contextual(c, "window partition keys")
+            tag_column(c, conf, reasons, notes)
+        for o in plan.window.order_cols:
+            inner = o.node[1] if o.node[0] == "sortorder" else o
+            _forbid_contextual(inner, "window order keys")
+            tag_column(inner, conf, reasons, notes)
+        node = plan.fn_col.node
+        if len(node) > 2 and isinstance(node[2], Column):
+            tag_column(node[2], conf, reasons, notes)
     return meta
 
 
@@ -458,7 +469,75 @@ class Planner:
             return self._convert_aggregate(plan, meta, kids[0], want_dev)
         if isinstance(plan, L.LogicalJoin):
             return self._convert_join(plan, meta, kids, want_dev)
+        if isinstance(plan, L.LogicalWindow):
+            return self._convert_window(plan, kids[0], want_dev)
         raise NotImplementedError(f"cannot convert {plan.name}")
+
+    def _convert_window(self, plan: "L.LogicalWindow", kid,
+                        want_dev: bool) -> Tuple[Exec, bool]:
+        """Window exec with its required distribution underneath
+        (GpuWindowExec.scala:92: hash-partition by the PARTITION BY keys,
+        or a single partition for empty PARTITION BY; ordering happens
+        inside the kernel's frame sort)."""
+        from spark_rapids_tpu.ops.window import (
+            DenseRank, Lag, Lead, Rank, RowNumber, WindowAgg, WindowExec,
+            WindowExprSpec, WindowFrame, WindowSpec)
+        child, cdev = kid
+        child = self._bridge(child, cdev, want_dev)
+        schema = plan.child.schema
+        win = plan.window
+        pcols = [resolve(c, schema) for c in win.partition_cols]
+        orders = []
+        for o in win.order_cols:
+            if o.node[0] == "sortorder":
+                inner, asc, nf = o.node[1], o.node[2], o.node[3]
+            else:
+                inner, asc, nf = o, True, True
+            from spark_rapids_tpu.ops.sort import SortOrder
+            orders.append(SortOrder(resolve(inner, schema), asc, nf))
+        node = plan.fn_col.node
+        if node[0] == "winfn":
+            kind, child_col, offset = node[1], node[2], node[3]
+            if kind in ("rank", "dense_rank", "row_number") and not orders:
+                raise L.ResolutionError(f"{kind}() requires ORDER BY")
+            if kind == "row_number":
+                fn = RowNumber()
+            elif kind == "rank":
+                fn = Rank()
+            elif kind == "dense_rank":
+                fn = DenseRank()
+            elif kind == "lead":
+                fn = Lead(resolve(child_col, schema), offset)
+            elif kind == "lag":
+                fn = Lag(resolve(child_col, schema), offset)
+            else:
+                raise L.ResolutionError(f"unknown window fn {kind!r}")
+        else:   # ("agg", kind, child)
+            kind, child_col = node[1], node[2]
+            agg_child = None if child_col is None \
+                else resolve(child_col, schema)
+            if win.frame is not None:
+                _, start, end = win.frame
+                if (start is not None and start > 0) or \
+                        (end is not None and end < 0):
+                    raise L.ResolutionError(
+                        "rows_between bounds must straddle the current row")
+                frame = WindowFrame(
+                    None if start is None else -start, end)
+            elif orders:
+                # Spark default: RANGE UNBOUNDED PRECEDING..CURRENT ROW.
+                frame = WindowFrame(None, 0, running_with_peers=True)
+            else:
+                frame = WindowFrame(None, None)   # whole partition
+            fn = WindowAgg(kind, agg_child, frame)
+        spec = WindowSpec(pcols, orders)
+        if pcols:
+            ex = self._hash_exchange(child, pcols,
+                                     self._shuffle_partitions())
+        else:
+            ex = ShuffleExchangeExec(child, SinglePartitioning())
+        return WindowExec(
+            ex, [WindowExprSpec(plan.out_name, fn, spec)]), want_dev
 
     def _sort_orders(self, plan: L.LogicalSort) -> List[SortOrder]:
         orders = []

@@ -65,10 +65,10 @@ def test_head_limit():
     hb = make_host([("a", srt.INT64)], {"a": list(range(6))})
     db = host_to_device(hb)
     out = db.head(4)
-    assert int(out.num_rows) == 4
+    assert int(out.live_count()) == 4
     assert device_to_host(out).columns[0].to_list() == [0, 1, 2, 3]
     out2 = db.head(100)
-    assert int(out2.num_rows) == 6
+    assert int(out2.live_count()) == 6
 
 
 def test_concat_batches():

@@ -1,0 +1,97 @@
+"""Window functions through the public DataFrame API (ref:
+GpuWindowExec.scala:92 planned via GpuOverrides.scala:1768 — here
+LogicalWindow + planner exchange insertion + Column.over)."""
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.plan.logical import (
+    Window, agg_avg, agg_count, agg_max, agg_sum, col, dense_rank, lag,
+    lead, rank, row_number)
+
+from harness import assert_rows_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+@pytest.fixture
+def df(session):
+    return session.create_dataframe(
+        {"g": ["a", "a", "b", "b", "b", None, "a"],
+         "x": [3, 1, 5, 4, 2, 7, None],
+         "y": [1.5, 2.5, 3.5, 4.5, 5.5, 6.5, 7.5]},
+        [("g", srt.STRING), ("x", srt.INT64), ("y", srt.FLOAT64)],
+        num_partitions=3)
+
+
+def dual(frame):
+    dev = sorted(frame.collect(), key=repr)
+    host = sorted(frame.collect_host(), key=repr)
+    assert_rows_equal(dev, host, approx_float=True,
+                      msg="device vs host engine")
+    return dev
+
+
+class TestWindowFrontend:
+    def test_row_number_rank(self, df):
+        w = Window.partition_by("g").order_by(col("x").desc())
+        out = dual(df.with_column("rn", row_number().over(w))
+                     .with_column("rk", rank().over(w))
+                     .with_column("dr", dense_rank().over(w)))
+        by_g = {}
+        for g, x, y, rn, rk, dr in out:
+            by_g.setdefault(g, []).append((x, rn))
+        # Nulls sort per spec; every partition numbers from 1.
+        for g, rows in by_g.items():
+            assert sorted(rn for _, rn in rows) == \
+                list(range(1, len(rows) + 1))
+
+    def test_running_and_whole_partition_aggs(self, df):
+        w = Window.partition_by("g").order_by(col("x").asc())
+        dual(df.with_column("rs", agg_sum(col("x")).over(w))
+               .with_column("tot", agg_sum(col("x")).over(
+                   Window.partition_by("g")))
+               .with_column("cnt", agg_count(col("x")).over(
+                   Window.partition_by("g")))
+               .with_column("mx", agg_max(col("y")).over(
+                   Window.partition_by("g"))))
+
+    def test_rows_frame_and_lead_lag(self, df):
+        w = Window.partition_by("g").order_by(col("x").asc())
+        dual(df.with_column("ms", agg_avg(col("y")).over(
+                 w.rows_between(-1, 1)))
+               .with_column("nxt", lead(col("x")).over(w))
+               .with_column("prv", lag(col("x")).over(w)))
+
+    def test_unpartitioned_window(self, df):
+        w = Window.order_by(col("x").asc())
+        dual(df.with_column("rn", row_number().over(w)))
+
+    def test_window_in_select(self, df):
+        w = Window.partition_by("g").order_by(col("x").desc())
+        out = dual(df.select("g", "x",
+                             row_number().over(w).alias("rn")))
+        assert all(len(r) == 3 for r in out)
+
+    def test_window_then_filter_topk(self, df):
+        """The TPC-DS q67 shape: rank within partition, keep rank <= k."""
+        w = Window.partition_by("g").order_by(col("x").desc())
+        out = dual(df.with_column("rk", rank().over(w))
+                     .filter(col("rk") <= 2))
+        for r in out:
+            assert r[3] <= 2
+
+    def test_rank_requires_order(self, df):
+        from spark_rapids_tpu.plan.logical import ResolutionError
+        bad = df.with_column("rk", rank().over(Window.partition_by("g")))
+        with pytest.raises(ResolutionError):
+            bad.collect()
+
+    def test_explain_shows_window(self, df):
+        w = Window.partition_by("g").order_by(col("x").asc())
+        report = df.with_column("rn", row_number().over(w)).explain()
+        assert "LogicalWindow" in report
