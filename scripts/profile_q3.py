@@ -21,6 +21,7 @@ def main():
 
     session = TpuSession()
     session.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    session.set("spark.rapids.sql.hasNans", False)
     df = tpch.QUERIES[qn](session, data_dir)
 
     # Warmup (compile)

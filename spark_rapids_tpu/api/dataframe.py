@@ -91,10 +91,12 @@ class DataFrameReader:
 
 
 class GroupedData:
-    def __init__(self, df: "DataFrame", keys: Sequence[Union[str, Column]]):
+    def __init__(self, df: "DataFrame", keys: Sequence[Union[str, Column]],
+                 grouping: Optional[str] = None):
         self._df = df
         self._keys = [(k, col(k)) if isinstance(k, str)
                       else (k.name_hint, k) for k in keys]
+        self._grouping = grouping
 
     def agg(self, *aggs: Column, **named: Column) -> "DataFrame":
         specs = []
@@ -102,7 +104,8 @@ class GroupedData:
             specs.append((self._agg_name(a), a))
         for name, a in named.items():
             specs.append((name, a))
-        plan = L.LogicalAggregate(self._df._plan, self._keys, specs)
+        plan = L.LogicalAggregate(self._df._plan, self._keys, specs,
+                                  grouping=self._grouping)
         return DataFrame(self._df._session, plan)
 
     @staticmethod
@@ -155,8 +158,18 @@ class DataFrame:
                     node = node[1].node
                 _, fn_col, windef = node
                 tmp = f"__window_{i}_{name}"
-                plan = L.LogicalWindow(plan, tmp, fn_col, windef)
+                plan = L.LogicalWindow(plan, [(tmp, fn_col)], windef)
                 out.append((name, col(tmp)))
+            elif L.is_generate_column(c):
+                node = c.node
+                while node[0] == "alias":
+                    node = node[1].node
+                _, elements, position, outer = node
+                plan = L.LogicalGenerate(plan, name, list(elements),
+                                         position, outer)
+                if position:
+                    out.append((f"{name}__pos", col(f"{name}__pos")))
+                out.append((name, col(name)))
             else:
                 out.append((name, c))
         return DataFrame(self._session, L.LogicalProject(plan, out))
@@ -186,6 +199,15 @@ class DataFrame:
         return GroupedData(self, keys)
 
     groupBy = group_by
+
+    def rollup(self, *keys: Union[str, Column]) -> GroupedData:
+        """GROUP BY ROLLUP: hierarchical subtotals via ExpandExec
+        (GpuExpandExec.scala)."""
+        return GroupedData(self, keys, grouping="rollup")
+
+    def cube(self, *keys: Union[str, Column]) -> GroupedData:
+        """GROUP BY CUBE: all key-subset subtotals via ExpandExec."""
+        return GroupedData(self, keys, grouping="cube")
 
     def agg(self, *aggs: Column, **named: Column) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs, **named)

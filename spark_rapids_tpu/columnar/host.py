@@ -241,24 +241,11 @@ def download_batches(batches: Sequence[DeviceBatch],
     loops cost O(batches*columns) round trips while this costs two.
     """
     import jax
-    from spark_rapids_tpu.columnar.batch import shrink_to_capacity
-    batches = list(batches)
-    counts: List[Optional[int]] = [b.rows_hint for b in batches]
+    from spark_rapids_tpu.columnar.batch import shrink_all
     # Selection-vector batches MUST materialize before download (their live
     # rows are scattered); padded dense batches shrink only when the saved
-    # bytes beat the row-count sync. Both pulls share one device_get.
-    unknown = [i for i, b in enumerate(batches)
-               if counts[i] is None
-               and (b.sel is not None
-                    or b.device_size_bytes() > _SHRINK_DOWNLOAD_BYTES)]
-    if unknown:
-        pulled = jax.device_get([batches[i].live_count() for i in unknown])
-        for i, n in zip(unknown, pulled):
-            counts[i] = int(n)
-    for i, n in enumerate(counts):
-        if n is not None:
-            batches[i] = shrink_to_capacity(
-                batches[i], bucket_capacity(max(n, 1)))
+    # bytes beat the row-count sync. One shared batched pull (shrink_all).
+    batches, _ = shrink_all(batches, min_bytes=_SHRINK_DOWNLOAD_BYTES)
     leaves: List = []
     for b in batches:
         leaves.append(b.num_rows)

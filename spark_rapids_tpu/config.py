@@ -151,8 +151,11 @@ INCOMPATIBLE_OPS = conf("spark.rapids.sql.incompatibleOps.enabled").doc(
 ).boolean(False)
 
 HAS_NANS = conf("spark.rapids.sql.hasNans").doc(
-    "Assume floating point data may contain NaNs; disables some fast paths "
-    "when true.").boolean(True)
+    "Assume floating point data may contain NaN/Infinity. When true (the "
+    "safe default), sum/avg aggregation carries out-of-band non-finite "
+    "occurrence streams through the cumsum fast path; setting it false "
+    "(the reference's common benchmark setting) drops that work entirely."
+).boolean(True)
 
 VARIABLE_FLOAT_AGG = conf("spark.rapids.sql.variableFloatAgg.enabled").doc(
     "Allow float/double aggregations whose result can vary with evaluation "

@@ -94,13 +94,19 @@ class AggFunction:
     # ~147ms/1M on this chip; a (1M, k) cumsum costs ~48ms TOTAL —
     # scripts/microbench.py). None = not sum-decomposable (min/max/first/
     # last keep the per-fn segment path).
-    def sum_terms_update(self, col: SortedCol) -> Optional[List[Tuple]]:
+    # ``has_nans`` mirrors spark.rapids.sql.hasNans: when the user asserts
+    # float data is finite, the out-of-band NaN/inf occurrence streams (3
+    # extra i32 cumsum columns per f64 sum) are skipped entirely.
+    def sum_terms_update(self, col: SortedCol,
+                         has_nans: bool = True) -> Optional[List[Tuple]]:
         return None
 
-    def sum_terms_merge(self, bufs: List[SortedCol]) -> Optional[List[Tuple]]:
+    def sum_terms_merge(self, bufs: List[SortedCol],
+                        has_nans: bool = True) -> Optional[List[Tuple]]:
         return None
 
-    def bufs_from_sums(self, sums: List, capacity: int) -> List[Buf]:
+    def bufs_from_sums(self, sums: List, capacity: int,
+                       has_nans: bool = True) -> List[Buf]:
         raise NotImplementedError
 
     # -- global (zero-key) fast path -------------------------------------
@@ -164,14 +170,14 @@ class Count(AggFunction):
         return b.data, b.validity, None
 
     # -- fast paths ------------------------------------------------------
-    def sum_terms_update(self, col):
+    def sum_terms_update(self, col, has_nans=True):
         return [("i32", col.validity.astype(jnp.int32))]
 
-    def sum_terms_merge(self, bufs):
+    def sum_terms_merge(self, bufs, has_nans=True):
         b, = bufs
         return [("i64", jnp.where(b.validity, b.data, 0))]
 
-    def bufs_from_sums(self, sums, capacity):
+    def bufs_from_sums(self, sums, capacity, has_nans=True):
         s, = sums
         return [(s.astype(jnp.int64), jnp.ones((capacity,), jnp.bool_),
                  None)]
@@ -250,14 +256,17 @@ class Sum(AggFunction):
     def _cls(self) -> str:
         return "f64" if self.result_type.is_floating else "i64"
 
-    def _terms(self, data, validity):
+    def _terms(self, data, validity, has_nans):
         """Masked value stream + count; float streams also carry NaN/inf
-        occurrence counts — the cumsum prefix-diff would otherwise let one
-        group's NaN poison every later group's sum."""
+        occurrence counts (unless hasNans=false asserts finiteness) — the
+        cumsum prefix-diff would otherwise let one group's NaN poison
+        every later group's sum."""
         t = self.result_type.np_dtype
         v = jnp.where(validity, data.astype(t), jnp.zeros((), t))
         if self._cls != "f64":
             return [("i64", v), ("i32", validity.astype(jnp.int32))]
+        if not has_nans:
+            return [("f64", v), ("i32", validity.astype(jnp.int32))]
         finite = jnp.isfinite(v)
         clean = jnp.where(finite, v, 0.0)
         return [("f64", clean), ("i32", validity.astype(jnp.int32)),
@@ -265,15 +274,15 @@ class Sum(AggFunction):
                 ("i32", (v == jnp.inf).astype(jnp.int32)),
                 ("i32", (v == -jnp.inf).astype(jnp.int32))]
 
-    def sum_terms_update(self, col):
-        return self._terms(col.data, col.validity)
+    def sum_terms_update(self, col, has_nans=True):
+        return self._terms(col.data, col.validity, has_nans)
 
-    def sum_terms_merge(self, bufs):
+    def sum_terms_merge(self, bufs, has_nans=True):
         b, = bufs
-        return self._terms(b.data, b.validity)
+        return self._terms(b.data, b.validity, has_nans)
 
-    def bufs_from_sums(self, sums, capacity):
-        if self._cls != "f64":
+    def bufs_from_sums(self, sums, capacity, has_nans=True):
+        if self._cls != "f64" or not has_nans:
             s, c = sums
             return [(s, c > 0, None)]
         s, c, nan, pinf, ninf = sums
@@ -433,26 +442,32 @@ class Average(AggFunction):
 
     # -- fast paths ------------------------------------------------------
     @staticmethod
-    def _f64_terms(v):
+    def _f64_terms(v, has_nans):
+        if not has_nans:
+            return [("f64", v)]
         finite = jnp.isfinite(v)
         return [("f64", jnp.where(finite, v, 0.0)),
                 ("i32", jnp.isnan(v).astype(jnp.int32)),
                 ("i32", (v == jnp.inf).astype(jnp.int32)),
                 ("i32", (v == -jnp.inf).astype(jnp.int32))]
 
-    def sum_terms_update(self, col):
+    def sum_terms_update(self, col, has_nans=True):
         masked = jnp.where(col.validity, col.data.astype(jnp.float64), 0.0)
-        return self._f64_terms(masked) + \
+        return self._f64_terms(masked, has_nans) + \
             [("i32", col.validity.astype(jnp.int32))]
 
-    def sum_terms_merge(self, bufs):
+    def sum_terms_merge(self, bufs, has_nans=True):
         sb, cb = bufs
-        return self._f64_terms(jnp.where(sb.validity, sb.data, 0.0)) + \
+        return self._f64_terms(jnp.where(sb.validity, sb.data, 0.0),
+                               has_nans) + \
             [("i64", jnp.where(cb.validity, cb.data, 0))]
 
-    def bufs_from_sums(self, sums, capacity):
-        s, nan, pinf, ninf, c = sums
-        s = _reapply_nonfinite(s, nan, pinf, ninf)
+    def bufs_from_sums(self, sums, capacity, has_nans=True):
+        if has_nans:
+            s, nan, pinf, ninf, c = sums
+            s = _reapply_nonfinite(s, nan, pinf, ninf)
+        else:
+            s, c = sums
         c = c.astype(jnp.int64)
         return [(s, c > 0, None),
                 (c, jnp.ones((capacity,), jnp.bool_), None)]
@@ -768,7 +783,8 @@ class HashAggregateExec(Exec):
             out[cls] = jnp.concatenate([Se[:1], Se[1:] - Se[:-1]], axis=0)
         return out
 
-    def _run_specs(self, spec_inputs, gid, slive, capacity, row_index):
+    def _run_specs(self, spec_inputs, gid, slive, capacity, row_index,
+                   has_nans: bool = True):
         """Shared spec-evaluation core: ``spec_inputs`` yields per spec
         ("update", SortedCol) or ("merge", [SortedCol...]). Sum-decomposable
         specs ride the stacked-cumsum path; the rest use their segment
@@ -776,8 +792,9 @@ class HashAggregateExec(Exec):
         stacks: dict = {}
         plans = []          # per spec: ("sum", [(cls, pos)...]) | ("raw", bufs)
         for spec, (kind, arg) in zip(self.aggs, spec_inputs):
-            terms = spec.fn.sum_terms_update(arg) if kind == "update" \
-                else spec.fn.sum_terms_merge(arg)
+            terms = spec.fn.sum_terms_update(arg, has_nans) \
+                if kind == "update" \
+                else spec.fn.sum_terms_merge(arg, has_nans)
             if terms is not None:
                 slots = []
                 for cls, values in terms:
@@ -795,7 +812,8 @@ class HashAggregateExec(Exec):
         for spec, plan in zip(self.aggs, plans):
             if plan[0] == "sum":
                 vals = [sums[cls][:, pos] for cls, pos in plan[1]]
-                out.append(spec.fn.bufs_from_sums(vals, capacity))
+                out.append(spec.fn.bufs_from_sums(vals, capacity,
+                                                  has_nans))
             else:
                 out.append(plan[1])
         return out
@@ -840,7 +858,7 @@ class HashAggregateExec(Exec):
             else:
                 inputs.append(("update", self._sorted_view(sorted_b, ord_)))
         bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap,
-                               row_index)
+                               row_index, self._has_nans)
         return self._assemble(work, g, bufs)
 
     def _merge_batch(self, batch: DeviceBatch) -> DeviceBatch:
@@ -857,7 +875,8 @@ class HashAggregateExec(Exec):
                            [self._sorted_view(sorted_b, ci + b)
                             for b in range(nbuf)]))
             ci += nbuf
-        bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap, None)
+        bufs = self._run_specs(inputs, g.group_of_sorted, slive, cap, None,
+                               self._has_nans)
         return self._assemble(batch, g, bufs)
 
     def _mixed_batch(self, batch: DeviceBatch) -> DeviceBatch:
@@ -994,16 +1013,24 @@ class HashAggregateExec(Exec):
             type(s.fn).update_row is not AggFunction.update_row
             for s in self.aggs)
 
+    _has_nans = True    # set from conf before the jits are built
+
     def _jits(self):
         """One jit wrapper per exec instance — jax caches compiled programs
-        on the wrapper, so partitions and repeated collects reuse them."""
-        if not hasattr(self, "_jit_fns"):
-            self._jit_fns = (jax.jit(self._update_batch),
-                             jax.jit(self._merge_batch),
-                             jax.jit(self._finalize_batch),
-                             jax.jit(self._mixed_batch),
-                             jax.jit(self._passthrough_batch))
-        return self._jit_fns
+        on the wrapper, so partitions and repeated collects reuse them.
+        Keyed by the hasNans mode (it changes the traced term layout)."""
+        cache = getattr(self, "_jit_fns", None)
+        if cache is None:
+            cache = self._jit_fns = {}
+        fns = cache.get(self._has_nans)
+        if fns is None:
+            fns = (jax.jit(self._update_batch),
+                   jax.jit(self._merge_batch),
+                   jax.jit(self._finalize_batch),
+                   jax.jit(self._mixed_batch),
+                   jax.jit(self._passthrough_batch))
+            cache[self._has_nans] = fns
+        return fns
 
     def _consolidate(self, ctx, m, pending: List[DeviceBatch],
                      final_stage: bool = False) -> DeviceBatch:
@@ -1015,20 +1042,11 @@ class HashAggregateExec(Exec):
         then everything merges in one grouped pass instead of the
         per-batch re-merge loop (which cost O(batches × accumulated size)
         device time)."""
-        import jax as _jax
         from spark_rapids_tpu.columnar.batch import (
-            jit_concat_batches, shrink_to_capacity)
+            jit_concat_batches, shrink_all)
         _, merge, finalize, mixed, _pt = self._jits()
-        counts = [b.rows_hint for b in pending]
-        unknown = [i for i, c in enumerate(counts) if c is None]
-        if unknown:
-            with timed(m, "sizesPullTime"):
-                pulled = _jax.device_get(
-                    [pending[i].live_count() for i in unknown])
-            for i, c in zip(unknown, pulled):
-                counts[i] = int(c)
-        shrunk = [shrink_to_capacity(b, bucket_capacity(max(c, 1)))
-                  for b, c in zip(pending, counts)]
+        with timed(m, "sizesPullTime"):
+            shrunk, _ = shrink_all(pending)
         if len(shrunk) > 1:
             cap = bucket_capacity(sum(b.capacity for b in shrunk))
             single = jit_concat_batches(shrunk, cap)
@@ -1047,7 +1065,9 @@ class HashAggregateExec(Exec):
 
     def execute_device(self, ctx, partition):
         import jax as _jax
+        from spark_rapids_tpu import config as _C
         m = ctx.metrics_for(self)
+        self._has_nans = bool(ctx.conf.get(_C.HAS_NANS))
         update, merge, finalize, mixed, passthrough = self._jits()
 
         from spark_rapids_tpu import config as C

@@ -193,6 +193,18 @@ class ShuffleExchangeExec(Exec):
         _WINDOW = 32
 
         def flush_window(window: List[DeviceBatch]):
+            if n == 1:
+                # Single destination: no pids, no sort, no slices — shrink
+                # each batch to its live bucket (using hints when known)
+                # and bucket it directly.
+                from spark_rapids_tpu.columnar.batch import shrink_all
+                pieces, counts1 = shrink_all(window)
+                for piece, cnt in zip(pieces, counts1):
+                    if cnt == 0:
+                        continue
+                    buckets[0].append(SpillableBatch(
+                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+                return
             metas = [(b,) + tuple(pids_fn(b)) for b in window]
             pulled = jax.device_get([m[2] for m in metas])
             for (batch, pids, _), counts in zip(metas, pulled):
@@ -356,16 +368,8 @@ class BroadcastExchangeExec(Exec):
         # One batched sizes pull, then shrink members to live scale: the
         # broadcast build side's capacity bounds every probe-side gather
         # downstream, so padding here multiplies into the join.
-        from spark_rapids_tpu.columnar.batch import shrink_to_capacity
-        counts = [b.rows_hint for b in batches]
-        unknown = [i for i, c in enumerate(counts) if c is None]
-        if unknown:
-            pulled = jax.device_get(
-                [batches[i].live_count() for i in unknown])
-            for i, c in zip(unknown, pulled):
-                counts[i] = int(c)
-        batches = [shrink_to_capacity(b, bucket_capacity(max(c, 1)))
-                   for b, c in zip(batches, counts)]
+        from spark_rapids_tpu.columnar.batch import shrink_all
+        batches, _ = shrink_all(batches)
         total = sum(b.capacity for b in batches)
         single = batches[0] if len(batches) == 1 else \
             concat_batches(batches, bucket_capacity(total))

@@ -50,10 +50,17 @@ def _uniform_shards(batches_per_dev: List[List[DeviceBatch]],
     """Coalesce each device's batches and pad all shards to one common
     capacity + per-column string width (shard_map needs uniform shapes)."""
     from spark_rapids_tpu.ops.sort import coalesce_to_single_batch
+    from spark_rapids_tpu.columnar.rowmove import compact_batch
     shards = []
     for blist in batches_per_dev:
         if blist:
-            shards.append(coalesce_to_single_batch(blist))
+            single = coalesce_to_single_batch(blist)
+            if single.sel is not None:
+                # A lone filtered batch passes through coalesce with its
+                # selection vector; shard_map shards are sel-less, so
+                # materialize the live rows first.
+                single = jax.jit(compact_batch)(single)
+            shards.append(single)
         else:
             shards.append(None)
     caps = [s.capacity for s in shards if s is not None]

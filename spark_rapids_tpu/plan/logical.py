@@ -566,6 +566,35 @@ def is_window_column(c: Column) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# Generate DSL (explode of inline arrays; ref GpuGenerateExec.scala)
+# ---------------------------------------------------------------------------
+
+def explode(*elements) -> Column:
+    """explode(array(e1, .., ek)): one output row per element. The engine's
+    type envelope is scalar-only (reference isSupportedType gate), so the
+    array is inline — K element expressions per row."""
+    return Column(("explode", tuple(_as_col(e) for e in elements),
+                   False, False))
+
+
+def explode_outer(*elements) -> Column:
+    return Column(("explode", tuple(_as_col(e) for e in elements),
+                   False, True))
+
+
+def posexplode(*elements) -> Column:
+    return Column(("explode", tuple(_as_col(e) for e in elements),
+                   True, False))
+
+
+def is_generate_column(c: Column) -> bool:
+    node = c.node
+    while node[0] == "alias":
+        node = node[1].node
+    return node[0] == "explode"
+
+
+# ---------------------------------------------------------------------------
 # Expression resolution (name -> ordinal, untyped -> typed)
 # ---------------------------------------------------------------------------
 
@@ -850,10 +879,15 @@ class LogicalProject(_Unary):
 
 class LogicalAggregate(_Unary):
     def __init__(self, child, group_by: Sequence[Tuple[str, Column]],
-                 aggregates: Sequence[Tuple[str, Column]]):
+                 aggregates: Sequence[Tuple[str, Column]],
+                 grouping: Optional[str] = None):
         super().__init__(child)
         self.group_by = list(group_by)
         self.aggregates = list(aggregates)
+        # None = plain GROUP BY; "rollup"/"cube" lower through ExpandExec
+        # (GROUPING SETS, GpuExpandExec.scala).
+        assert grouping in (None, "rollup", "cube")
+        self.grouping = grouping
 
     @property
     def schema(self) -> Schema:
@@ -868,21 +902,27 @@ class LogicalAggregate(_Unary):
 
 
 class LogicalWindow(_Unary):
-    """Appends ONE window-expression column to the child
+    """Appends window-expression columns sharing ONE window spec
     (ExtractWindowExpressions analog: the DataFrame layer extracts window
     columns out of select/with_column into a chain of these nodes; the
-    planner inserts the co-locating exchange underneath —
-    GpuWindowExec.scala:92 requiredChildDistribution)."""
+    planner merges adjacent nodes with the same spec and inserts the
+    co-locating exchange underneath — GpuWindowExec.scala:92
+    requiredChildDistribution)."""
 
-    def __init__(self, child, out_name: str, fn_col: Column,
-                 window: "WindowDef"):
+    def __init__(self, child, exprs, window: "WindowDef"):
         super().__init__(child)
-        self.out_name = out_name
-        self.fn_col = fn_col            # ("winfn", ...) or ("agg", ...)
+        self.exprs = list(exprs)        # [(out_name, fn_col Column)]
         self.window = window
 
-    def result_type(self) -> DataType:
-        node = self.fn_col.node
+    def spec_key(self):
+        """Hashable structural identity of the window spec, for merging
+        adjacent nodes that shuffle+sort identically."""
+        return (tuple(canonical_node(c) for c in self.window.partition_cols),
+                tuple(canonical_node(c) for c in self.window.order_cols),
+                self.window.frame)
+
+    def result_type(self, fn_col: Column) -> DataType:
+        node = fn_col.node
         if node[0] == "winfn":
             kind = node[1]
             if kind in ("row_number", "rank", "dense_rank"):
@@ -903,8 +943,33 @@ class LogicalWindow(_Unary):
 
     @property
     def schema(self) -> Schema:
-        return tuple(self.child.schema) + \
-            ((self.out_name, self.result_type()),)
+        return tuple(self.child.schema) + tuple(
+            (n, self.result_type(c)) for n, c in self.exprs)
+
+
+class LogicalGenerate(_Unary):
+    """explode/posexplode of an inline array (GpuGenerateExec.scala):
+    appends [pos?, element] columns, one output row per (row, element)."""
+
+    def __init__(self, child, out_name: str, elements: Sequence[Column],
+                 position: bool = False, outer: bool = False):
+        super().__init__(child)
+        self.out_name = out_name
+        self.elements = list(elements)
+        self.position = position
+        self.outer = outer
+
+    def element_type(self) -> DataType:
+        t0 = resolve(self.elements[0], self.child.schema).data_type()
+        return t0
+
+    @property
+    def schema(self) -> Schema:
+        out = list(self.child.schema)
+        if self.position:
+            out.append((f"{self.out_name}__pos", dt.INT32))
+        out.append((self.out_name, self.element_type()))
+        return tuple(out)
 
 
 class LogicalSort(_Unary):

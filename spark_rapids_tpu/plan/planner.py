@@ -243,6 +243,10 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
         for k in (plan.keys or []):
             _forbid_contextual(k, "repartition keys")
             tag_column(k, conf, reasons, notes)
+    elif isinstance(plan, L.LogicalGenerate):
+        for c in plan.elements:
+            _forbid_contextual(c, "explode elements")
+            tag_column(c, conf, reasons, notes)
     elif isinstance(plan, L.LogicalWindow):
         for c in plan.window.partition_cols:
             _forbid_contextual(c, "window partition keys")
@@ -251,10 +255,40 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
             inner = o.node[1] if o.node[0] == "sortorder" else o
             _forbid_contextual(inner, "window order keys")
             tag_column(inner, conf, reasons, notes)
-        node = plan.fn_col.node
-        if len(node) > 2 and isinstance(node[2], Column):
-            tag_column(node[2], conf, reasons, notes)
+        for _, fn_col in plan.exprs:
+            node = fn_col.node
+            if len(node) > 2 and isinstance(node[2], Column):
+                tag_column(node[2], conf, reasons, notes)
     return meta
+
+
+def merge_windows(plan: LogicalPlan) -> LogicalPlan:
+    """Collapse chains of LogicalWindow nodes with the SAME window spec
+    into one multi-expression node: each node plans an exchange + a
+    partition sort, so N window columns over one spec would otherwise
+    shuffle and sort N times (Spark's ExtractWindowExpressions groups the
+    same way before planning one Window operator)."""
+    kids = [merge_windows(c) for c in plan.children]
+    if not all(a is b for a, b in zip(kids, plan.children)):
+        import copy
+        plan = copy.copy(plan)
+        plan.children = tuple(kids)
+    if isinstance(plan, L.LogicalWindow) and \
+            isinstance(plan.child, L.LogicalWindow) and \
+            plan.spec_key() == plan.child.spec_key():
+        inner = plan.child
+        # Only merge when the outer expressions don't read the inner
+        # node's outputs (a window fn over another window's result must
+        # stay a separate pass).
+        from spark_rapids_tpu.plan.pruning import refs_of
+        refs: set = set()
+        for _, fn_col in plan.exprs:
+            refs_of(fn_col, refs)
+        if not refs & {n for n, _ in inner.exprs}:
+            return merge_windows(L.LogicalWindow(
+                inner.child, list(inner.exprs) + list(plan.exprs),
+                inner.window))
+    return plan
 
 
 # ---------------------------------------------------------------------------
@@ -356,7 +390,7 @@ class Planner:
     def plan(self, logical: LogicalPlan) -> PhysicalPlan:
         from spark_rapids_tpu.plan.pruning import (
             prune_columns, pushdown_filters)
-        logical = pushdown_filters(prune_columns(logical))
+        logical = pushdown_filters(prune_columns(merge_windows(logical)))
         self._force_perfile = _uses_input_file(logical)
         meta = wrap_and_tag(logical, self.conf)
         if self.conf.explain in ("ALL", "NOT_ON_GPU"):
@@ -471,6 +505,16 @@ class Planner:
             return self._convert_join(plan, meta, kids, want_dev)
         if isinstance(plan, L.LogicalWindow):
             return self._convert_window(plan, kids[0], want_dev)
+        if isinstance(plan, L.LogicalGenerate):
+            from spark_rapids_tpu.ops.generate import GenerateExec
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            schema = plan.child.schema
+            elements = [resolve(c, schema) for c in plan.elements]
+            return GenerateExec(
+                child, elements, position=plan.position, outer=plan.outer,
+                element_name=plan.out_name,
+                skip_nulls=plan.outer), want_dev
         raise NotImplementedError(f"cannot convert {plan.name}")
 
     def _convert_window(self, plan: "L.LogicalWindow", kid,
@@ -495,49 +539,53 @@ class Planner:
                 inner, asc, nf = o, True, True
             from spark_rapids_tpu.ops.sort import SortOrder
             orders.append(SortOrder(resolve(inner, schema), asc, nf))
-        node = plan.fn_col.node
-        if node[0] == "winfn":
-            kind, child_col, offset = node[1], node[2], node[3]
-            if kind in ("rank", "dense_rank", "row_number") and not orders:
-                raise L.ResolutionError(f"{kind}() requires ORDER BY")
-            if kind == "row_number":
-                fn = RowNumber()
-            elif kind == "rank":
-                fn = Rank()
-            elif kind == "dense_rank":
-                fn = DenseRank()
-            elif kind == "lead":
-                fn = Lead(resolve(child_col, schema), offset)
-            elif kind == "lag":
-                fn = Lag(resolve(child_col, schema), offset)
-            else:
-                raise L.ResolutionError(f"unknown window fn {kind!r}")
-        else:   # ("agg", kind, child)
-            kind, child_col = node[1], node[2]
-            agg_child = None if child_col is None \
-                else resolve(child_col, schema)
-            if win.frame is not None:
-                _, start, end = win.frame
-                if (start is not None and start > 0) or \
-                        (end is not None and end < 0):
-                    raise L.ResolutionError(
-                        "rows_between bounds must straddle the current row")
-                frame = WindowFrame(
-                    None if start is None else -start, end)
-            elif orders:
-                # Spark default: RANGE UNBOUNDED PRECEDING..CURRENT ROW.
-                frame = WindowFrame(None, 0, running_with_peers=True)
-            else:
-                frame = WindowFrame(None, None)   # whole partition
-            fn = WindowAgg(kind, agg_child, frame)
         spec = WindowSpec(pcols, orders)
+        wx_specs = []
+        for out_name, fn_col in plan.exprs:
+            node = fn_col.node
+            if node[0] == "winfn":
+                kind, child_col, offset = node[1], node[2], node[3]
+                if kind in ("rank", "dense_rank", "row_number") \
+                        and not orders:
+                    raise L.ResolutionError(f"{kind}() requires ORDER BY")
+                if kind == "row_number":
+                    fn = RowNumber()
+                elif kind == "rank":
+                    fn = Rank()
+                elif kind == "dense_rank":
+                    fn = DenseRank()
+                elif kind == "lead":
+                    fn = Lead(resolve(child_col, schema), offset)
+                elif kind == "lag":
+                    fn = Lag(resolve(child_col, schema), offset)
+                else:
+                    raise L.ResolutionError(f"unknown window fn {kind!r}")
+            else:   # ("agg", kind, child)
+                kind, child_col = node[1], node[2]
+                agg_child = None if child_col is None \
+                    else resolve(child_col, schema)
+                if win.frame is not None:
+                    _, start, end = win.frame
+                    if (start is not None and start > 0) or \
+                            (end is not None and end < 0):
+                        raise L.ResolutionError(
+                            "rows_between bounds must straddle the "
+                            "current row")
+                    frame = WindowFrame(
+                        None if start is None else -start, end)
+                elif orders:
+                    # Spark default: RANGE UNBOUNDED..CURRENT ROW.
+                    frame = WindowFrame(None, 0, running_with_peers=True)
+                else:
+                    frame = WindowFrame(None, None)   # whole partition
+                fn = WindowAgg(kind, agg_child, frame)
+            wx_specs.append(WindowExprSpec(out_name, fn, spec))
         if pcols:
             ex = self._hash_exchange(child, pcols,
                                      self._shuffle_partitions())
         else:
             ex = ShuffleExchangeExec(child, SinglePartitioning())
-        return WindowExec(
-            ex, [WindowExprSpec(plan.out_name, fn, spec)]), want_dev
+        return WindowExec(ex, wx_specs), want_dev
 
     def _sort_orders(self, plan: L.LogicalSort) -> List[SortOrder]:
         orders = []
@@ -559,11 +607,81 @@ class Planner:
         aggs = [AggSpec(n, fn, distinct=getattr(fn, "is_distinct", False))
                 for n, fn in ((n, resolve_agg(c, schema))
                               for n, c in plan.aggregates)]
+        if plan.grouping is not None:
+            if any(s.distinct for s in aggs):
+                raise L.ResolutionError(
+                    "DISTINCT aggregates under rollup/cube are unsupported")
+            return self._convert_grouping_sets(
+                plan.grouping, group_by, aggs, child, want_dev)
         if any(s.distinct for s in aggs):
             return self._convert_distinct_aggregate(
                 group_by, aggs, child, want_dev)
         # Two-stage: partial -> exchange on group keys -> final
         # (aggregate.scala partial/final mode pair across the shuffle).
+        return self._two_stage(group_by, aggs, child, want_dev)
+
+    def _convert_grouping_sets(self, kind: str, group_by, aggs, child,
+                               want_dev: bool) -> Tuple[Exec, bool]:
+        """ROLLUP/CUBE via ExpandExec (GpuExpandExec.scala; Spark lowers
+        grouping sets to Expand + Aggregate keyed by (keys...,
+        grouping_id)): each input row is emitted once per grouping set,
+        with aggregated-out keys NULLed and a grouping-id literal so a
+        data NULL never merges with a subtotal NULL. A final projection
+        drops the grouping id."""
+        from spark_rapids_tpu.exprs.base import Literal
+        nk = len(group_by)
+        if kind == "rollup":
+            # Set i keeps the first nk-i keys; gid bit per dropped key.
+            masks = [(1 << i) - 1 for i in range(nk + 1)]
+        else:
+            masks = list(range(1 << nk))
+        agg_children = []
+        for s in aggs:
+            agg_children.append(s.fn.child)
+        names = [n for n, _ in group_by] + \
+            [f"__agg_in{i}" for i in range(len(agg_children))] + \
+            ["__grouping_id"]
+        projections = []
+        for mask in masks:
+            proj = []
+            for i, (_, e) in enumerate(group_by):
+                dropped = mask & (1 << (nk - 1 - i)) if kind == "cube" \
+                    else (i >= nk - bin(mask).count("1"))
+                proj.append(Literal(e.data_type(), None) if dropped else e)
+            for ce in agg_children:
+                proj.append(ce if ce is not None
+                            else Literal(dt.INT32, 1))
+            proj.append(Literal(dt.INT64, mask))
+            projections.append(proj)
+        expand = ExpandExec(child, projections, names)
+        # Re-key everything by ordinal over the expand output.
+        ex_group = [(n, BoundReference(i, e.data_type()))
+                    for i, (n, e) in enumerate(group_by)]
+        ex_group.append(("__grouping_id", BoundReference(
+            nk + len(agg_children), dt.INT64)))
+        ex_aggs = []
+        for i, s in enumerate(aggs):
+            if s.fn.child is None:
+                ex_aggs.append(s)
+                continue
+            ref = BoundReference(nk + i, s.fn.child.data_type())
+            if isinstance(s.fn, (First, Last)):
+                fn = type(s.fn)(ref, s.fn.ignore_nulls)
+            else:
+                fn = type(s.fn)(ref)
+            ex_aggs.append(AggSpec(s.name, fn))
+        final, dev = self._two_stage(ex_group, ex_aggs, expand, want_dev)
+        # Drop the grouping id from the output.
+        out = [(n, BoundReference(i, e.data_type()))
+               for i, (n, e) in enumerate(ex_group[:nk])]
+        out += [(s.name, BoundReference(nk + 1 + i, s.fn.result_type))
+                for i, s in enumerate(ex_aggs)]
+        return ProjectExec(final, out), dev
+
+    def _two_stage(self, group_by, aggs, child,
+                   want_dev: bool) -> Tuple[Exec, bool]:
+        """partial -> hash exchange -> final (shared by plain and
+        grouping-set aggregates)."""
         partial = HashAggregateExec(child, group_by, aggs, mode="partial")
         nkeys = len(group_by)
         if nkeys:

@@ -150,21 +150,33 @@ def _prune(plan: LogicalPlan, required: Optional[Set[str]]) -> LogicalPlan:
         for _, c in plan.aggregates:
             refs_of(c, child_req)
         return L.LogicalAggregate(_prune(plan.child, child_req),
-                                  plan.group_by, plan.aggregates)
+                                  plan.group_by, plan.aggregates,
+                                  grouping=plan.grouping)
     if isinstance(plan, L.LogicalWindow):
         child_req = None
         if required is not None:
-            child_req = set(required) - {plan.out_name}
+            child_req = set(required) - {n for n, _ in plan.exprs}
             for c in plan.window.partition_cols:
                 refs_of(c, child_req)
             for o in plan.window.order_cols:
                 inner = o.node[1] if o.node[0] == "sortorder" else o
                 refs_of(inner, child_req)
-            node = plan.fn_col.node
-            if len(node) > 2 and isinstance(node[2], L.Column):
-                refs_of(node[2], child_req)
+            for _, fn_col in plan.exprs:
+                node = fn_col.node
+                if len(node) > 2 and isinstance(node[2], L.Column):
+                    refs_of(node[2], child_req)
         return L.LogicalWindow(_prune(plan.child, child_req),
-                               plan.out_name, plan.fn_col, plan.window)
+                               plan.exprs, plan.window)
+    if isinstance(plan, L.LogicalGenerate):
+        child_req = None
+        if required is not None:
+            child_req = set(required) - {plan.out_name,
+                                         f"{plan.out_name}__pos"}
+            for c in plan.elements:
+                refs_of(c, child_req)
+        return L.LogicalGenerate(_prune(plan.child, child_req),
+                                 plan.out_name, plan.elements,
+                                 plan.position, plan.outer)
     if isinstance(plan, L.LogicalSort):
         child_req = None
         if required is not None:

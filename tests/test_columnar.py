@@ -17,10 +17,15 @@ def make_host(schema, data):
 
 
 def test_bucket_capacity():
+    # Ladder rungs at 2^k and 3*2^(k-1): 8, 12, 16, 24, 32, ...
     assert bucket_capacity(0) == 8
     assert bucket_capacity(8) == 8
-    assert bucket_capacity(9) == 16
+    assert bucket_capacity(9) == 12
+    assert bucket_capacity(13) == 16
+    assert bucket_capacity(17) == 24
     assert bucket_capacity(1000) == 1024
+    assert bucket_capacity(1025) == 1536
+    assert bucket_capacity(750_000) == 768 * 1024
 
 
 @pytest.mark.parametrize("dtype,values", [

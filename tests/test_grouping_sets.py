@@ -1,0 +1,82 @@
+"""ROLLUP/CUBE (via ExpandExec) and explode (via GenerateExec) through the
+public DataFrame API (ref: GpuExpandExec.scala / GpuGenerateExec.scala,
+registered in GpuOverrides.scala:1768-1977)."""
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.plan.logical import (
+    agg_count, agg_sum, col, explode, explode_outer, posexplode)
+
+from harness import assert_rows_equal
+
+
+@pytest.fixture
+def session():
+    return TpuSession()
+
+
+def dual(frame):
+    dev = sorted(frame.collect(), key=repr)
+    host = sorted(frame.collect_host(), key=repr)
+    assert_rows_equal(dev, host, approx_float=True,
+                      msg="device vs host engine")
+    return dev
+
+
+class TestRollupCube:
+    @pytest.fixture
+    def df(self, session):
+        return session.create_dataframe(
+            {"a": ["x", "x", "y", None], "b": [1, 2, 1, 1],
+             "v": [10.0, 20.0, 30.0, 40.0]},
+            [("a", srt.STRING), ("b", srt.INT64), ("v", srt.FLOAT64)],
+            num_partitions=2)
+
+    def test_rollup(self, df):
+        out = dual(df.rollup("a", "b").agg(
+            agg_sum(col("v")).alias("s"), agg_count().alias("c")))
+        # 4 data groups + 3 level-1 subtotals + 1 grand total.
+        assert len(out) == 8
+        assert (None, None, 100.0, 4) in out      # grand total
+
+    def test_cube(self, df):
+        out = dual(df.cube("a", "b").agg(agg_sum(col("v")).alias("s")))
+        # 4 (a,b) + 3 (a) + 2 (b) + 1 () = 10 groups.
+        assert len(out) == 10
+
+    def test_rollup_single_key(self, df):
+        out = dual(df.rollup("a").agg(agg_count().alias("c")))
+        assert len(out) == 4                      # x, y, NULL, total
+        assert (None, 4) in out
+
+    def test_data_null_stays_distinct_from_subtotal(self, df):
+        out = dual(df.rollup("a").agg(agg_sum(col("v")).alias("s")))
+        nulls = [r for r in out if r[0] is None]
+        # Data NULL group (40.0) and grand total (100.0) both present.
+        assert sorted(r[1] for r in nulls) == [40.0, 100.0]
+
+
+class TestExplodeFrontend:
+    @pytest.fixture
+    def df(self, session):
+        return session.create_dataframe(
+            {"id": [1, 2], "a": [10, None], "b": [20, 40]},
+            [("id", srt.INT64), ("a", srt.INT64), ("b", srt.INT64)],
+            num_partitions=2)
+
+    def test_explode(self, df):
+        out = dual(df.select("id", explode(col("a"), col("b")).alias("v")))
+        assert out == sorted([(1, 10), (1, 20), (2, None), (2, 40)],
+                             key=repr)
+
+    def test_posexplode(self, df):
+        out = dual(df.select(
+            "id", posexplode(col("a"), col("b")).alias("v")))
+        assert all(len(r) == 3 for r in out)
+
+    def test_explode_then_agg(self, df):
+        out = dual(df.select("id", explode(col("a"), col("b")).alias("v"))
+                     .group_by("id").agg(agg_count(col("v")).alias("c")))
+        assert sorted(out) == [(1, 2), (2, 1)]
