@@ -1,0 +1,172 @@
+// Host-side compression codecs for spilled blobs and shuffle payloads.
+//
+// TPU-native analog of the reference's TableCompressionCodec SPI
+// (sql-plugin/.../TableCompressionCodec.scala:41) whose GPU implementation
+// is nvcomp LZ4 (NvcompLZ4CompressionCodec.scala). There is no accelerator
+// decompressor on the TPU side (XLA has no byte-oriented kernels), so the
+// codec runs where the spilled bytes live: on the host, in native code, on
+// the spill/shuffle write+read paths.
+//
+// Self-contained LZ4 *block format* implementation (the image ships no
+// lz4.h): greedy hash-chain-less matcher with a 2^16-entry hash table,
+// standard token/literal/match encoding, 64KB window. Decompression is
+// format-exact, so blocks interoperate with any LZ4 block decoder.
+//
+// C ABI (ctypes-friendly):
+//   int64 lz4_compress_bound(int64 n)
+//   int64 lz4_compress(src, n, dst, dst_cap)   -> compressed size or -1
+//   int64 lz4_decompress(src, n, dst, dst_cap) -> decompressed size or -1
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int kMinMatch = 4;
+constexpr int kHashBits = 16;
+constexpr uint32_t kHashMul = 2654435761u;
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(const uint8_t* p) {
+  return (read32(p) * kHashMul) >> (32 - kHashBits);
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t lz4_compress_bound(int64_t n) {
+  // LZ4 worst case: n + n/255 + 16.
+  return n + n / 255 + 16;
+}
+
+int64_t lz4_compress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                     int64_t dst_cap) {
+  if (src_len < 0 || dst_cap < lz4_compress_bound(src_len)) return -1;
+  uint32_t table[1 << kHashBits];
+  std::memset(table, 0, sizeof(table));
+
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + src_len;
+  // Matches must end >= 12 bytes before the end (format requirement:
+  // last 5 bytes are literals, and a match can't start in the last 12).
+  const uint8_t* const mflimit = src + src_len - 12;
+  const uint8_t* anchor = src;
+  uint8_t* op = dst;
+
+  if (src_len >= 13) {
+    ip++;  // first byte can't match (offset 0 is invalid)
+    while (ip <= mflimit) {
+      uint32_t h = hash4(ip);
+      const uint8_t* match = src + table[h];
+      table[h] = static_cast<uint32_t>(ip - src);
+      if (match < ip && ip - match <= 0xFFFF && match >= src &&
+          read32(match) == read32(ip)) {
+        // Extend the match forward.
+        const uint8_t* mp = match + kMinMatch;
+        const uint8_t* cp = ip + kMinMatch;
+        const uint8_t* climit = src + src_len - 5;
+        while (cp < climit && *cp == *mp) { cp++; mp++; }
+        int64_t match_len = cp - ip - kMinMatch;
+        int64_t lit_len = ip - anchor;
+        // Token.
+        uint8_t* token = op++;
+        if (lit_len >= 15) {
+          *token = 15 << 4;
+          int64_t l = lit_len - 15;
+          while (l >= 255) { *op++ = 255; l -= 255; }
+          *op++ = static_cast<uint8_t>(l);
+        } else {
+          *token = static_cast<uint8_t>(lit_len) << 4;
+        }
+        std::memcpy(op, anchor, lit_len);
+        op += lit_len;
+        // Offset (little endian).
+        uint16_t off = static_cast<uint16_t>(ip - match);
+        *op++ = off & 0xFF;
+        *op++ = off >> 8;
+        // Match length.
+        if (match_len >= 15) {
+          *token |= 15;
+          int64_t l = match_len - 15;
+          while (l >= 255) { *op++ = 255; l -= 255; }
+          *op++ = static_cast<uint8_t>(l);
+        } else {
+          *token |= static_cast<uint8_t>(match_len);
+        }
+        ip = cp;
+        anchor = ip;
+      } else {
+        ip++;
+      }
+    }
+  }
+  // Final literal run.
+  int64_t lit_len = iend - anchor;
+  uint8_t* token = op++;
+  if (lit_len >= 15) {
+    *token = 15 << 4;
+    int64_t l = lit_len - 15;
+    while (l >= 255) { *op++ = 255; l -= 255; }
+    *op++ = static_cast<uint8_t>(l);
+  } else {
+    *token = static_cast<uint8_t>(lit_len) << 4;
+  }
+  std::memcpy(op, anchor, lit_len);
+  op += lit_len;
+  return op - dst;
+}
+
+int64_t lz4_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                       int64_t dst_cap) {
+  const uint8_t* ip = src;
+  const uint8_t* const iend = src + src_len;
+  uint8_t* op = dst;
+  uint8_t* const oend = dst + dst_cap;
+
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    // Literals.
+    int64_t lit_len = token >> 4;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (ip + lit_len > iend || op + lit_len > oend) return -1;
+    std::memcpy(op, ip, lit_len);
+    ip += lit_len;
+    op += lit_len;
+    if (ip >= iend) break;  // last block: literals only
+    // Match.
+    if (ip + 2 > iend) return -1;
+    uint16_t offset = ip[0] | (ip[1] << 8);
+    ip += 2;
+    if (offset == 0 || op - dst < offset) return -1;
+    int64_t match_len = (token & 15) + kMinMatch;
+    if ((token & 15) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        match_len += b;
+      } while (b == 255);
+    }
+    if (op + match_len > oend) return -1;
+    const uint8_t* match = op - offset;
+    // Byte-by-byte: overlapping copies are the RLE case.
+    for (int64_t i = 0; i < match_len; i++) op[i] = match[i];
+    op += match_len;
+  }
+  return op - dst;
+}
+
+}  // extern "C"

@@ -220,9 +220,11 @@ STABLE_SORT = conf("spark.rapids.sql.stableSort.enabled").doc(
 
 SHUFFLE_COMPRESSION_CODEC = conf(
     "spark.rapids.shuffle.compression.codec").doc(
-    "Codec for shuffle partition payloads: none or copy (testing). "
-    "(ref: nvcomp LZ4; TPU path keeps data in HBM so codec is host-side "
-    "only when spilled.)").string("none")
+    "Codec for spilled shuffle/buffer blobs: lz4 (native LZ4 block "
+    "format, memory/compression.py + native/compress.cpp), copy "
+    "(framing only, testing), or none. The reference compresses with "
+    "nvcomp LZ4 on-GPU; the TPU path keeps live data in HBM, so the "
+    "codec applies on the host at the disk-spill boundary.").string("lz4")
 
 SCAN_CACHE_BYTES = conf(
     "spark.rapids.sql.format.scanCache.maxBytes").doc(

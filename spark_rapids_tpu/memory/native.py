@@ -24,31 +24,35 @@ _lib = None
 _tried = False
 
 
-def _compile() -> bool:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-           "-o", _SO, _SRC]
+def compile_and_load(src_path: str, so_name: str):
+    """Shared compile-on-first-use loader for the repo's native sources
+    (g++ is in the image; pybind11 is not, so bindings are a plain C ABI
+    over ctypes). Rebuilds when the source is newer than the .so; returns
+    the CDLL or None when no toolchain is available."""
+    so_path = os.path.join(_BUILD_DIR, so_name)
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return False
+        if not os.path.exists(so_path) or \
+                os.path.getmtime(so_path) < os.path.getmtime(src_path):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", so_path, src_path],
+                check=True, capture_output=True, timeout=120)
+        return ctypes.CDLL(so_path)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return None
 
 
 def load():
-    """Load (compiling if needed) the native library; None on failure."""
+    """Load (compiling if needed) the spill-store library; None on
+    failure."""
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO) or \
-                os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not _compile():
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+        lib = compile_and_load(_SRC, "libspillstore.so")
+        if lib is None:
             return None
         lib.spill_store_create.restype = ctypes.c_void_p
         lib.spill_store_create.argtypes = [ctypes.c_char_p]

@@ -126,7 +126,9 @@ class BufferCatalog:
 
     def __init__(self, device_budget_bytes: int = 1 << 34,
                  host_budget_bytes: int = 1 << 30,
-                 spill_dir: str = "/tmp/spark_rapids_tpu_spill"):
+                 spill_dir: str = "/tmp/spark_rapids_tpu_spill",
+                 compression_codec: str = "none"):
+        from spark_rapids_tpu.memory.compression import get_codec
         from spark_rapids_tpu.memory.native import open_spill_file
         self.device_budget = device_budget_bytes
         self.host_budget = host_budget_bytes
@@ -136,8 +138,13 @@ class BufferCatalog:
         self._host_bytes = 0
         self._lock = threading.RLock()
         self._spill_file = open_spill_file(spill_dir)
+        # Disk-tier blobs compress through the codec SPI
+        # (spark.rapids.shuffle.compression.codec; TableCompressionCodec
+        # analog — see memory/compression.py).
+        self._codec = get_codec(compression_codec)
         self.metrics = {"spill_to_host": 0, "spill_to_disk": 0,
-                        "restore_from_host": 0, "restore_from_disk": 0}
+                        "restore_from_host": 0, "restore_from_disk": 0,
+                        "disk_bytes_raw": 0, "disk_bytes_stored": 0}
 
     # -- registration --------------------------------------------------------
     def add_batch(self, batch: DeviceBatch,
@@ -174,6 +181,9 @@ class BufferCatalog:
             else:
                 self.metrics["restore_from_disk"] += 1
                 blob = self._spill_file.read(e.disk_block)
+                if self._codec is not None:
+                    blob = self._codec.decompress(
+                        blob, e.disk_meta["raw_len"])
                 bufs = _deserialize_bufs(blob, e.disk_directory)
                 batch = _numpy_to_batch(e.disk_meta, bufs)
                 self._spill_file.free(e.disk_block)
@@ -242,14 +252,20 @@ class BufferCatalog:
 
     def _spill_host_to_disk(self, e: BufferEntry):
         blob, directory = _serialize_bufs(e.host_bufs)
+        raw_len = len(blob)
+        if self._codec is not None:
+            blob = self._codec.compress(blob)
         block = self._spill_file.write(blob)
-        e.disk_meta = e.host_meta
+        e.disk_meta = dict(e.host_meta)
+        e.disk_meta["raw_len"] = raw_len
         e.disk_directory = directory
         e.disk_block = block
         e.host_meta = e.host_bufs = None
         e.tier = StorageTier.DISK
         self._host_bytes -= e.size_bytes
         self.metrics["spill_to_disk"] += 1
+        self.metrics["disk_bytes_raw"] += raw_len
+        self.metrics["disk_bytes_stored"] += len(blob)
 
     # -- introspection -------------------------------------------------------
     def tier_of(self, buffer_id: int) -> str:
@@ -302,6 +318,24 @@ class SpillableBatch:
     def __exit__(self, *exc):
         self.release()
         return False
+
+
+_GLOBAL_SEM: Optional["TpuSemaphore"] = None
+_GLOBAL_SEM_LOCK = threading.Lock()
+
+
+def get_tpu_semaphore(permits: int) -> "TpuSemaphore":
+    """THE process-wide admission semaphore, sized by the FIRST
+    ``spark.rapids.sql.concurrentTpuTasks`` value seen (the reference
+    sizes one GpuSemaphore per executor once at startup —
+    GpuSemaphore.scala:63; later conf changes are likewise ignored so the
+    device bound stays global across sessions). Exec.collect acquires it
+    around device work."""
+    global _GLOBAL_SEM
+    with _GLOBAL_SEM_LOCK:
+        if _GLOBAL_SEM is None:
+            _GLOBAL_SEM = TpuSemaphore(permits)
+        return _GLOBAL_SEM
 
 
 class TpuSemaphore:

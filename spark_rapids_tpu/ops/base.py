@@ -78,7 +78,9 @@ class ExecContext:
                 device_budget_bytes=budget,
                 host_budget_bytes=int(
                     self.conf.get(C.HOST_SPILL_STORAGE_SIZE)),
-                spill_dir=str(self.conf.get(C.SPILL_DIR)))
+                spill_dir=str(self.conf.get(C.SPILL_DIR)),
+                compression_codec=str(
+                    self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)))
         return self._catalog
 
     def close(self):
@@ -144,11 +146,23 @@ class Exec:
         rows: List[tuple] = []
         names = tuple(n for n, _ in self.schema)
         if device:
+            from spark_rapids_tpu import config as C
             from spark_rapids_tpu.columnar.host import download_batches
-            batches: List[DeviceBatch] = []
-            for p in range(self.num_partitions(ctx)):
-                batches.extend(self.execute_device(ctx, p))
-            for hb in download_batches(batches, names):
+            from spark_rapids_tpu.memory.stores import get_tpu_semaphore
+            # Task admission (GpuSemaphore.scala:74-87): at most
+            # concurrentTpuTasks collects issue device work at once, so
+            # concurrent queries can't oversubscribe HBM.
+            sem = get_tpu_semaphore(
+                max(int(ctx.conf.get(C.CONCURRENT_TPU_TASKS)), 1))
+            with sem:
+                batches: List[DeviceBatch] = []
+                for p in range(self.num_partitions(ctx)):
+                    batches.extend(self.execute_device(ctx, p))
+                host_batches = download_batches(batches, names)
+            # Row materialization is pure host CPU — outside the permit,
+            # like the reference releasing GpuSemaphore once the task
+            # leaves the device.
+            for hb in host_batches:
                 rows.extend(hb.to_pylist())
         else:
             for p in range(self.num_partitions(ctx)):

@@ -114,20 +114,26 @@ def sort_key_passes(col: DeviceColumn, ascending: bool,
 
 
 def lex_sort_perm(passes: List[jnp.ndarray], live: jnp.ndarray,
-                  capacity: int) -> jnp.ndarray:
-    """Stable permutation sorting rows by the MSW-first word passes; dead
-    rows (padding / deselected) always sort last. ``live`` is either a
-    (capacity,) bool mask (row_mask) or an int32 row-count scalar."""
+                  capacity: int, stable: bool = True) -> jnp.ndarray:
+    """Permutation sorting rows by the MSW-first word passes; dead rows
+    (padding / deselected) always sort last. ``live`` is either a
+    (capacity,) bool mask (row_mask) or an int32 row-count scalar.
+
+    ``stable=False`` (spark.rapids.sql.stableSort.enabled off) relaxes
+    tie order on the least-significant pass only — every later LSD radix
+    pass must stay stable for multi-key correctness."""
     if getattr(live, "ndim", 0) == 0 or np.isscalar(live):
         live = jnp.arange(capacity, dtype=jnp.int32) < live
     pad_last = jnp.where(live, jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
     perm = jnp.arange(capacity, dtype=jnp.int32)
     # LSD radix over words: apply stable argsort from least significant pass
     # to most significant; padding pass last (most significant of all).
+    first = True
     for words in reversed(passes):
         keyed = jnp.take(words, perm, axis=0)
-        order = jnp.argsort(keyed, stable=True)
+        order = jnp.argsort(keyed, stable=stable or not first)
         perm = jnp.take(perm, order, axis=0)
+        first = False
     keyed = jnp.take(pad_last, perm, axis=0)
     order = jnp.argsort(keyed, stable=True)
     return jnp.take(perm, order, axis=0)

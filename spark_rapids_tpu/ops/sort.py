@@ -46,7 +46,8 @@ def coalesce_to_single_batch(batches: List[DeviceBatch]) -> DeviceBatch:
     return jit_concat_batches(batches, bucket_capacity(total_cap))
 
 
-def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]) -> DeviceBatch:
+def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder],
+               stable: bool = True) -> DeviceBatch:
     """Device kernel: fully sort one batch by the sort orders. Selected
     (live) rows sort to the front, so the output is dense (sel discharged
     by the gather)."""
@@ -55,7 +56,8 @@ def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]) -> DeviceBatch:
         col = as_device_column(o.child.eval(batch), batch)
         passes.extend(kernels.sort_key_passes(col, o.ascending,
                                               o.nulls_first))
-    perm = kernels.lex_sort_perm(passes, batch.row_mask(), batch.capacity)
+    perm = kernels.lex_sort_perm(passes, batch.row_mask(), batch.capacity,
+                                 stable=stable)
     return batch.gather(perm, batch.live_count())
 
 
@@ -73,14 +75,18 @@ class SortExec(Exec):
         return self.children[0].schema
 
     def execute_device(self, ctx, partition):
+        from spark_rapids_tpu import config as C
         m = ctx.metrics_for(self)
         batches = list(self.children[0].execute_device(ctx, partition))
         if not batches:
             return
         single = coalesce_to_single_batch(batches)
+        stable = bool(ctx.conf.get(C.STABLE_SORT))
         if self._jit is None and all(o.child.jittable for o in self.orders):
-            self._jit = jax.jit(lambda b: sort_batch(b, self.orders))
-        fn = self._jit or (lambda b: sort_batch(b, self.orders))
+            self._jit = jax.jit(
+                lambda b: sort_batch(b, self.orders, stable=stable))
+        fn = self._jit or (lambda b: sort_batch(b, self.orders,
+                                                stable=stable))
         with timed(m):
             out = fn(single)
         m.add("numOutputBatches", 1)

@@ -63,6 +63,14 @@ _INCOMPAT_EXPRS = {
 _HOST_ROUNDTRIP_EXPRS = {"regexp_replace", "regexp_extract", "translate",
                          "lpad", "rpad", "replace"}
 
+# Transcendentals whose XLA lowering can round differently from
+# java.lang.Math (GpuOverrides marks the same family incompat); allowed by
+# spark.rapids.sql.improvedFloatOps.enabled or incompatibleOps.enabled.
+_IMPROVED_FLOAT_EXPRS = {
+    "exp", "expm1", "log", "log10", "log2", "log1p", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "cbrt", "pow", "atan2",
+}
+
 # Kinds whose value depends on the task context rather than column inputs.
 _CONTEXTUAL_EXPRS = {
     "rand": "nondeterministic (distribution-equal to Spark, not "
@@ -129,8 +137,10 @@ def _exec_conf_key(name: str) -> str:
 
 
 def tag_column(c: Column, conf: C.TpuConf, reasons: List[str],
-               notes: List[str]):
-    """Walk an untyped Column AST, collecting fallback reasons."""
+               notes: List[str], schema=None):
+    """Walk an untyped Column AST, collecting fallback reasons. ``schema``
+    (when available) enables type-directed gates like the float<->string
+    cast checks (GpuCast meta tagging, GpuOverrides.scala:442)."""
     kind = c.node[0]
     if not conf.is_op_enabled(_expr_conf_key(kind)):
         reasons.append(f"expression {kind} disabled by "
@@ -139,21 +149,42 @@ def tag_column(c: Column, conf: C.TpuConf, reasons: List[str],
         reasons.append(
             f"expression {kind} is incompatible ({_INCOMPAT_EXPRS[kind]}); "
             "enable spark.rapids.sql.incompatibleOps.enabled to allow")
+    if kind in _IMPROVED_FLOAT_EXPRS and not conf.incompatible_ops and \
+            not conf.get(C.IMPROVED_FLOAT_OPS):
+        reasons.append(
+            f"expression {kind} can round differently from java.lang.Math "
+            "on TPU; enable spark.rapids.sql.improvedFloatOps.enabled")
+    if kind == "cast" and schema is not None:
+        try:
+            src = resolve(c.node[1], schema).data_type()
+        except Exception:
+            src = None
+        dst = c.node[2]
+        if src is not None and src.is_floating and dst.is_string and \
+                not conf.get(C.CAST_FLOAT_TO_STRING):
+            reasons.append(
+                "casting floats to string formats differently from Spark; "
+                "enable spark.rapids.sql.castFloatToString.enabled")
+        if src is not None and src.is_string and dst.is_floating and \
+                not conf.get(C.CAST_STRING_TO_FLOAT):
+            reasons.append(
+                "casting strings to float differs in corner cases; "
+                "enable spark.rapids.sql.castStringToFloat.enabled")
     if kind in _HOST_ROUNDTRIP_EXPRS:
         notes.append(f"expression {kind} runs via a host roundtrip")
     if kind in _CONTEXTUAL_EXPRS:
         notes.append(f"expression {kind}: {_CONTEXTUAL_EXPRS[kind]}")
     for x in c.node[1:]:
         if isinstance(x, Column):
-            tag_column(x, conf, reasons, notes)
+            tag_column(x, conf, reasons, notes, schema)
         elif isinstance(x, tuple):
             for y in x:
                 if isinstance(y, Column):
-                    tag_column(y, conf, reasons, notes)
+                    tag_column(y, conf, reasons, notes, schema)
                 elif isinstance(y, tuple):
                     for z in y:
                         if isinstance(z, Column):
-                            tag_column(z, conf, reasons, notes)
+                            tag_column(z, conf, reasons, notes, schema)
 
 
 def _float_agg_reasons(agg_col: Column, schema, conf: C.TpuConf,
@@ -211,54 +242,67 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
         reasons.append(f"disabled by {_exec_conf_key(plan.name)}")
 
     if isinstance(plan, L.LogicalFilter):
-        tag_column(plan.condition, conf, reasons, notes)
+        tag_column(plan.condition, conf, reasons, notes,
+                   plan.child.schema)
     elif isinstance(plan, L.LogicalProject):
         for _, c in plan.projections:
-            tag_column(c, conf, reasons, notes)
+            tag_column(c, conf, reasons, notes, plan.child.schema)
     elif isinstance(plan, L.LogicalAggregate):
         for _, c in plan.group_by:
             _forbid_contextual(c, "group_by")
-            tag_column(c, conf, reasons, notes)
+            tag_column(c, conf, reasons, notes, plan.child.schema)
         for _, c in plan.aggregates:
             _forbid_contextual(c, "aggregates")
             ac = _unalias(c)
             inner = ac.node[2] if ac.node[0] in ("agg", "aggd") else None
             if inner is not None:
-                tag_column(inner, conf, reasons, notes)
+                tag_column(inner, conf, reasons, notes, plan.child.schema)
             if ac.node[0] in ("agg", "aggd"):
                 _float_agg_reasons(ac, plan.child.schema, conf, reasons)
     elif isinstance(plan, L.LogicalSort):
         for o in plan.orders:
             inner = o.node[1] if o.node[0] == "sortorder" else o
             _forbid_contextual(inner, "order_by")
-            tag_column(inner, conf, reasons, notes)
+            tag_column(inner, conf, reasons, notes, plan.child.schema)
     elif isinstance(plan, L.LogicalJoin):
-        for k in plan.left_keys + plan.right_keys:
+        if plan.strategy == "shuffle" and plan.left_keys and \
+                not conf.get(C.REPLACE_SORT_MERGE_JOIN):
+            reasons.append(
+                "co-partitioned (sort-merge-shaped) join replacement "
+                "disabled by spark.rapids.sql.replaceSortMergeJoin.enabled")
+        ls = plan.children[0].schema
+        rs = plan.children[1].schema
+        for k in plan.left_keys:
             _forbid_contextual(k, "join keys")
-            tag_column(k, conf, reasons, notes)
+            tag_column(k, conf, reasons, notes, ls)
+        for k in plan.right_keys:
+            _forbid_contextual(k, "join keys")
+            tag_column(k, conf, reasons, notes, rs)
         if plan.condition is not None:
             _forbid_contextual(plan.condition, "join condition")
-            tag_column(plan.condition, conf, reasons, notes)
+            tag_column(plan.condition, conf, reasons, notes,
+                       tuple(ls) + tuple(rs))
     elif isinstance(plan, L.LogicalRepartition):
         for k in (plan.keys or []):
             _forbid_contextual(k, "repartition keys")
-            tag_column(k, conf, reasons, notes)
+            tag_column(k, conf, reasons, notes, plan.child.schema)
     elif isinstance(plan, L.LogicalGenerate):
         for c in plan.elements:
             _forbid_contextual(c, "explode elements")
-            tag_column(c, conf, reasons, notes)
+            tag_column(c, conf, reasons, notes, plan.child.schema)
     elif isinstance(plan, L.LogicalWindow):
         for c in plan.window.partition_cols:
             _forbid_contextual(c, "window partition keys")
-            tag_column(c, conf, reasons, notes)
+            tag_column(c, conf, reasons, notes, plan.child.schema)
         for o in plan.window.order_cols:
             inner = o.node[1] if o.node[0] == "sortorder" else o
             _forbid_contextual(inner, "window order keys")
-            tag_column(inner, conf, reasons, notes)
+            tag_column(inner, conf, reasons, notes, plan.child.schema)
         for _, fn_col in plan.exprs:
             node = fn_col.node
             if len(node) > 2 and isinstance(node[2], Column):
-                tag_column(node[2], conf, reasons, notes)
+                tag_column(node[2], conf, reasons, notes,
+                           plan.child.schema)
     return meta
 
 
