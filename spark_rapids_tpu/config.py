@@ -133,6 +133,25 @@ BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
     "TPU addition: row capacity, not just bytes, is what bounds XLA "
     "recompilation.").long(1 << 20)
 
+AUTO_BROADCAST_THRESHOLD = conf(
+    "spark.rapids.sql.autoBroadcastJoinThreshold").doc(
+    "Joins with strategy 'auto' broadcast the build side when its "
+    "estimated size (parquet footer stats propagated through the plan) "
+    "is at most this many bytes, else hash-shuffle both sides — the "
+    "stats-driven half of AQE-lite (ref GpuCustomShuffleReaderExec / "
+    "Spark autoBroadcastJoinThreshold). -1 always broadcasts (the "
+    "pre-stats behavior).").long(64 * 1024 * 1024)
+
+AQE_COALESCE_PARTITIONS = conf(
+    "spark.rapids.sql.aqe.coalescePartitions.enabled").doc(
+    "After a shuffle materializes, merge undersized reduce partitions "
+    "using their now-exact row counts (GpuCustomShuffleReaderExec.scala:"
+    "132 coalesced-partition reader analog).").boolean(True)
+
+AQE_COALESCE_TARGET_ROWS = conf(
+    "spark.rapids.sql.aqe.coalescePartitions.targetRows").doc(
+    "Row target per post-shuffle partition when coalescing.").long(1 << 20)
+
 AGG_SKIP_PARTIAL_RATIO = conf(
     "spark.rapids.sql.agg.skipAggPassReductionRatio").doc(
     "When the first partial-aggregation batch reduces its input by less "

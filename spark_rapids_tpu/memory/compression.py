@@ -78,7 +78,10 @@ class CopyCodec(CompressionCodec):
         return data
 
     def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
-        assert len(data) == uncompressed_size
+        if len(data) != uncompressed_size:
+            raise OSError(
+                f"copy codec blob is {len(data)} of "
+                f"{uncompressed_size} bytes")
         return data
 
 
@@ -119,7 +122,10 @@ class ZlibFallbackCodec(CompressionCodec):
 
     def decompress(self, data: bytes, uncompressed_size: int) -> bytes:
         out = zlib.decompress(data)
-        assert len(out) == uncompressed_size
+        if len(out) != uncompressed_size:
+            raise OSError(
+                f"zlib fallback produced {len(out)} of "
+                f"{uncompressed_size} bytes")
         return out
 
 

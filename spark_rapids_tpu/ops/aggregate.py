@@ -1032,32 +1032,55 @@ class HashAggregateExec(Exec):
             cache[self._has_nans] = fns
         return fns
 
+    # Max batches concatenated per merge step: bounds the transient HBM of
+    # a consolidation to CHUNK x batch-capacity (a 70-wide concat of
+    # high-cardinality partials OOMed the chip on TPC-DS q67's rollup).
+    _CONSOLIDATE_CHUNK = 12
+
     def _consolidate(self, ctx, m, pending: List[DeviceBatch],
                      final_stage: bool = False) -> DeviceBatch:
-        """Shrink + concat + single merge over the pending list.
+        """Chunked tree of shrink + concat + merge over the pending list.
 
-        ONE batched sizes pull covers every hint-less batch (a sync is a
-        full network round trip on a tunneled chip; exchange pieces carry
-        ``rows_hint`` so the final stage usually needs no sync at all),
-        then everything merges in one grouped pass instead of the
-        per-batch re-merge loop (which cost O(batches × accumulated size)
-        device time)."""
+        Each level does ONE batched sizes pull for its hint-less batches
+        (a sync is a full network round trip on a tunneled chip; exchange
+        pieces carry ``rows_hint`` so the final stage's first level
+        usually needs no sync), concats chunks of at most
+        ``_CONSOLIDATE_CHUNK`` members, and runs the grouping stage on
+        each chunk — grouping shrinks the data level by level, so peak
+        HBM stays bounded regardless of how many partials a partition
+        accumulated. mixed_final's distinct-update kernel is chunk-safe:
+        its distinct inputs are globally unique rows, so chunk updates
+        followed by plain merges count each value exactly once."""
         from spark_rapids_tpu.columnar.batch import (
             jit_concat_batches, shrink_all)
         _, merge, finalize, mixed, _pt = self._jits()
-        with timed(m, "sizesPullTime"):
-            shrunk, _ = shrink_all(pending)
-        if len(shrunk) > 1:
-            cap = bucket_capacity(sum(b.capacity for b in shrunk))
-            single = jit_concat_batches(shrunk, cap)
-        else:
-            single = shrunk[0]
-        # Raw-input modes always need their grouping stage; update-stage
-        # partials only when several were concatenated together.
-        if self.mode == "mixed_final":
-            single = mixed(single)
-        elif self.mode in ("final", "merge") or len(pending) > 1:
-            single = merge(single)
+        first_stage = {"final": merge, "merge": merge,
+                       "mixed_final": mixed}.get(self.mode)
+        level = 0
+        batches = pending
+        while True:
+            with timed(m, "sizesPullTime"):
+                batches, _ = shrink_all(batches)
+            if len(batches) == 1:
+                single = batches[0]
+                if level == 0 and first_stage is not None:
+                    single = first_stage(single)
+                break
+            stage = first_stage if (level == 0 and
+                                    first_stage is not None) else merge
+            nxt = []
+            for i in range(0, len(batches), self._CONSOLIDATE_CHUNK):
+                grp = batches[i:i + self._CONSOLIDATE_CHUNK]
+                if len(grp) == 1:
+                    nxt.append(stage(grp[0]))
+                    continue
+                cap = bucket_capacity(sum(b.capacity for b in grp))
+                nxt.append(stage(jit_concat_batches(grp, cap)))
+            batches = nxt
+            level += 1
+            if len(batches) == 1:
+                single = batches[0]
+                break
         if final_stage and self.mode in ("final", "complete",
                                          "mixed_final"):
             single = finalize(single)

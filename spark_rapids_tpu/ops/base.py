@@ -143,6 +143,9 @@ class Exec:
         ``download_batches`` call — on a tunneled device that is two
         round trips for the whole query instead of O(batches)."""
         ctx = ctx or ExecContext()
+        # Engine marker: runtime-adaptive pieces (AQE partition coalescing)
+        # must only trigger device materialization on the device engine.
+        ctx.cache.setdefault("engine", "device" if device else "host")
         rows: List[tuple] = []
         names = tuple(n for n, _ in self.schema)
         if device:

@@ -50,18 +50,62 @@ def _slice_rows(batch: DeviceBatch, start, size: int,
 
 
 class ShuffleExchangeExec(Exec):
-    """Repartition the child by a Partitioning strategy."""
+    """Repartition the child by a Partitioning strategy.
 
-    def __init__(self, child: Exec, partitioning: Partitioning):
+    ``allow_coalesce`` opts this exchange into AQE-lite partition
+    coalescing (GpuCustomShuffleReaderExec.scala:132 analog): once the
+    map side materializes, the EXACT per-bucket row counts are known, and
+    undersized adjacent reduce partitions merge up to the target. The
+    planner enables it where partition identity is not load-bearing
+    (aggregate/window/sort exchanges) and keeps it off for co-partitioned
+    join inputs, whose two sides must stay aligned bucket-for-bucket."""
+
+    def __init__(self, child: Exec, partitioning: Partitioning,
+                 allow_coalesce: bool = False):
         super().__init__(child)
         self.partitioning = partitioning
+        self.allow_coalesce = allow_coalesce
         self._split_jit = None
 
     @property
     def schema(self) -> Schema:
         return self.children[0].schema
 
+    def _groups(self, ctx) -> Optional[List[List[int]]]:
+        """Coalesced bucket groups (device engine only), or None."""
+        from spark_rapids_tpu import config as C
+        n = self.partitioning.num_partitions
+        if not self.allow_coalesce or n <= 1 or \
+                ctx.cache.get("engine") != "device" or \
+                not bool(ctx.conf.get(C.AQE_COALESCE_PARTITIONS)):
+            return None
+        gkey = f"shuffle-groups:{id(self):x}"
+        groups = ctx.cache.get(gkey)
+        if groups is None:
+            self._materialize_device(ctx)
+            sizes = ctx.cache.get(self._cache_key(True) + ":rows",
+                                  [0] * n)
+            target = int(ctx.conf.get(C.AQE_COALESCE_TARGET_ROWS))
+            groups = []
+            cur: List[int] = []
+            cur_rows = 0
+            for b in range(n):
+                if cur and cur_rows + sizes[b] > target:
+                    groups.append(cur)
+                    cur, cur_rows = [], 0
+                cur.append(b)
+                cur_rows += sizes[b]
+            if cur:
+                groups.append(cur)
+            m = ctx.metrics_for(self)
+            m.add("coalescedPartitions", n - len(groups))
+            ctx.cache[gkey] = groups
+        return groups
+
     def num_partitions(self, ctx) -> int:
+        groups = self._groups(ctx)
+        if groups is not None:
+            return len(groups)
         return self.partitioning.num_partitions
 
     # -- materialization (the "map side") ------------------------------------
@@ -180,6 +224,7 @@ class ShuffleExchangeExec(Exec):
         self._ensure_bounds(ctx, device=True)
         n = self.partitioning.num_partitions
         buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        bucket_rows = [0] * n           # exact counts (AQE coalescing)
         from spark_rapids_tpu.columnar.batch import shrink_to_capacity
         from spark_rapids_tpu.memory.stores import (
             PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
@@ -202,6 +247,7 @@ class ShuffleExchangeExec(Exec):
                 for piece, cnt in zip(pieces, counts1):
                     if cnt == 0:
                         continue
+                    bucket_rows[0] += cnt
                     buckets[0].append(SpillableBatch(
                         ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
                 return
@@ -229,6 +275,7 @@ class ShuffleExchangeExec(Exec):
                     if counts[p] == 0:
                         continue
                     piece.rows_hint = counts[p]
+                    bucket_rows[p] += counts[p]
                     # Shuffle output is spillable (RapidsCachingWriter
                     # inserts into the device store; shuffle spills FIRST
                     # per SpillPriorities) — the bucket holds a handle,
@@ -246,6 +293,7 @@ class ShuffleExchangeExec(Exec):
         if window:
             flush_window(window)
         ctx.cache[key] = buckets
+        ctx.cache[key + ":rows"] = bucket_rows
         return buckets
 
     def _materialize_host(self, ctx) -> List[List[HostBatch]]:
@@ -317,8 +365,11 @@ class ShuffleExchangeExec(Exec):
                 for sb in pending:
                     sb.release(PRIORITY_SHUFFLE_OUTPUT)
 
+        groups = self._groups(ctx)
+        mine = groups[partition] if groups is not None else [partition]
         try:
-            for sb in buckets[partition]:
+            for b in mine:
+              for sb in buckets[b]:
                 if group and group_cap + sb.capacity > target:
                     yield from serve(group)
                     group, group_cap = [], 0

@@ -470,15 +470,19 @@ class Planner:
     def _mesh_enabled(self) -> bool:
         return bool(self.conf.get(C.MESH_ENABLED))
 
-    def _hash_exchange(self, child: Exec, keys, n: int) -> Exec:
+    def _hash_exchange(self, child: Exec, keys, n: int,
+                       allow_coalesce: bool = False) -> Exec:
         """Hash shuffle: collective mesh exchange when a mesh is
-        configured, else the materialized single-process exchange."""
+        configured, else the materialized single-process exchange.
+        ``allow_coalesce`` opts into AQE-lite partition merging — safe for
+        aggregate/window exchanges, NOT for co-partitioned join inputs."""
         part = HashPartitioning(keys, n)
         if self._mesh_enabled():
             from spark_rapids_tpu.parallel.mesh_exchange import \
                 MeshExchangeExec
             return MeshExchangeExec(child, part)
-        return ShuffleExchangeExec(child, part)
+        return ShuffleExchangeExec(child, part,
+                                   allow_coalesce=allow_coalesce)
 
     def _convert(self, meta: NodeMeta) -> Tuple[Exec, bool]:
         plan = meta.plan
@@ -541,7 +545,8 @@ class Planner:
             # Global order: range-exchange into sorted partition ranges
             # first (Spark's requiredChildDistribution for global sort).
             ex = ShuffleExchangeExec(
-                child, RangePartitioning(orders, self._shuffle_partitions()))
+                child, RangePartitioning(orders, self._shuffle_partitions()),
+                allow_coalesce=want_dev)
             return SortExec(ex, orders), want_dev
         if isinstance(plan, L.LogicalAggregate):
             return self._convert_aggregate(plan, meta, kids[0], want_dev)
@@ -626,7 +631,8 @@ class Planner:
             wx_specs.append(WindowExprSpec(out_name, fn, spec))
         if pcols:
             ex = self._hash_exchange(child, pcols,
-                                     self._shuffle_partitions())
+                                     self._shuffle_partitions(),
+                                     allow_coalesce=want_dev)
         else:
             ex = ShuffleExchangeExec(child, SinglePartitioning())
         return WindowExec(ex, wx_specs), want_dev
@@ -732,7 +738,8 @@ class Planner:
             keys = [BoundReference(i, e.data_type())
                     for i, (_, e) in enumerate(group_by)]
             ex = self._hash_exchange(partial, keys,
-                                     self._shuffle_partitions())
+                                     self._shuffle_partitions(),
+                                     allow_coalesce=want_dev)
         else:
             ex = ShuffleExchangeExec(partial, SinglePartitioning())
         final_groups = [
@@ -774,7 +781,8 @@ class Planner:
             keys = [BoundReference(i, e.data_type())
                     for i, (_, e) in enumerate(group_by)]
             ex = self._hash_exchange(stage_a, keys,
-                                     self._shuffle_partitions())
+                                     self._shuffle_partitions(),
+                                     allow_coalesce=want_dev)
         else:
             ex = ShuffleExchangeExec(stage_a, SinglePartitioning())
         # Stage B: merge, still keyed by (keys..., x) over the buffer
@@ -814,10 +822,28 @@ class Planner:
                 lch, rch, plan.join_type, cond), want_dev
         strategy = plan.strategy
         if strategy == "auto":
-            # Without table stats, broadcast unless full outer (which needs
-            # co-partitioning); AQE-style stats can upgrade this later.
-            strategy = "shuffle" if plan.join_type == "full" \
-                else "broadcast"
+            # Stats-driven choice (autoBroadcastJoinThreshold): broadcast
+            # when the build side's estimated bytes fit the threshold,
+            # else hash-shuffle both sides. Full outer always needs
+            # co-partitioning.
+            if plan.join_type == "full":
+                strategy = "shuffle"
+            else:
+                threshold = int(self.conf.get(C.AUTO_BROADCAST_THRESHOLD))
+                if threshold < 0:
+                    strategy = "broadcast"
+                else:
+                    from spark_rapids_tpu.plan.pruning import estimate_bytes
+                    build_plan = plan.children[1] \
+                        if plan.join_type != "right" else plan.children[0]
+                    est = estimate_bytes(build_plan)
+                    strategy = "broadcast" \
+                        if est is not None and est <= threshold \
+                        else "shuffle"
+                    meta.notes.append(
+                        f"auto join strategy -> {strategy} (build side "
+                        f"~{est if est is not None else '?'} bytes, "
+                        f"threshold {threshold})")
         if strategy == "broadcast":
             return BroadcastHashJoinExec(
                 lch, rch, lkeys, rkeys, plan.join_type, cond), want_dev

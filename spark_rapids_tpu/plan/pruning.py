@@ -104,6 +104,55 @@ def pushdown_filters(plan: LogicalPlan) -> LogicalPlan:
     return _with_children(plan, rebuilt)
 
 
+def estimate_bytes(plan: LogicalPlan) -> Optional[int]:
+    """Size-in-bytes estimate for join-strategy planning (the
+    SizeInBytesOnlyStatsPlanVisitor analog feeding
+    autoBroadcastJoinThreshold). FileScans read EXACT uncompressed sizes
+    of the pruned columns from parquet footers (cached); other nodes
+    propagate conservatively (filters/aggregates keep their child's
+    size, matching Spark's non-CBO stats). None = unknown (never
+    broadcast on unknown)."""
+    if isinstance(plan, L.FileScan):
+        if plan.fmt != "parquet":
+            return None
+        from spark_rapids_tpu.io.scan import _parquet_metadata
+        names = {n for n, _ in plan.source_schema}
+        total = 0
+        try:
+            for path in plan.paths:
+                md = _parquet_metadata(path)
+                for rg in range(md.num_row_groups):
+                    g = md.row_group(rg)
+                    for ci in range(g.num_columns):
+                        c = g.column(ci)
+                        if c.path_in_schema.split(".")[0] in names:
+                            total += c.total_uncompressed_size
+        except OSError:
+            return None
+        return total
+    if isinstance(plan, L.InMemoryScan):
+        total = 0
+        for part in plan.partitions:
+            for hb in part:
+                for c in hb.columns:
+                    total += c.num_rows * max(c.dtype.itemsize, 8)
+        return total
+    if isinstance(plan, L.LogicalRange):
+        rows = max(0, -(-(plan.end - plan.start) // plan.step)) \
+            if plan.step else 0
+        return 8 * rows
+    if isinstance(plan, (L.LogicalFilter, L.LogicalSort, L.LogicalLimit,
+                         L.LogicalRepartition, L.LogicalAggregate,
+                         L.LogicalProject, L.LogicalWindow)):
+        return estimate_bytes(plan.child)
+    if isinstance(plan, (L.LogicalUnion, L.LogicalJoin)):
+        sizes = [estimate_bytes(c) for c in plan.children]
+        if any(s is None for s in sizes):
+            return None
+        return sum(sizes)
+    return None
+
+
 def _with_children(plan: LogicalPlan, kids) -> LogicalPlan:
     """Shallow-copy a logical node with new children."""
     import copy
