@@ -139,8 +139,8 @@ AUTO_BROADCAST_THRESHOLD = conf(
     "estimated size (parquet footer stats propagated through the plan) "
     "is at most this many bytes, else hash-shuffle both sides — the "
     "stats-driven half of AQE-lite (ref GpuCustomShuffleReaderExec / "
-    "Spark autoBroadcastJoinThreshold). -1 always broadcasts (the "
-    "pre-stats behavior).").long(64 * 1024 * 1024)
+    "Spark autoBroadcastJoinThreshold semantics: -1 disables "
+    "auto-broadcast entirely).").long(64 * 1024 * 1024)
 
 AQE_COALESCE_PARTITIONS = conf(
     "spark.rapids.sql.aqe.coalescePartitions.enabled").doc(
@@ -262,7 +262,9 @@ SHUFFLE_PARTITIONS = conf("spark.rapids.sql.shuffle.partitions").doc(
 HBM_POOL_FRACTION = conf("spark.rapids.memory.tpu.allocFraction").doc(
     "Fraction of visible HBM the engine budgets for batch storage; the "
     "watermark evictor starts spilling above it (ref: RMM pool + "
-    "DeviceMemoryEventHandler).").double(0.9)
+    "DeviceMemoryEventHandler). Conservative default: the tunneled chip "
+    "reports no memory stats, and compute transients live outside the "
+    "budget.").double(0.6)
 
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
     "Bytes of host RAM for spilled device batches before going to disk."

@@ -1,0 +1,87 @@
+"""Host-evaluated Python UDF expression — the fallback half of the UDF
+tier (GpuArrowEvalPythonExec.scala:494 analog: the reference ships columns
+to Python workers over Arrow and reads results back; in-process, the
+device path downloads the argument columns, applies the function over
+python values, and uploads the result column)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceBatch
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.exprs.base import (
+    Expression, as_device_column, as_host_column)
+
+
+class PythonUDF(Expression):
+    """f(*args) applied row-wise with SQL-null passthrough of Nones."""
+
+    def __init__(self, func, return_type: DataType, children,
+                 reason: str = ""):
+        self.func = func
+        self._rt = return_type
+        self._children = tuple(children)
+        self.reason = reason        # why compilation failed (explain)
+
+    @property
+    def children(self) -> Tuple[Expression, ...]:
+        return self._children
+
+    def data_type(self) -> DataType:
+        return self._rt
+
+    @property
+    def self_jittable(self) -> bool:
+        return False
+
+    def _apply(self, arg_lists: List[list], n: int) -> HostColumn:
+        out = []
+        for i in range(n):
+            try:
+                out.append(self.func(*[a[i] for a in arg_lists]))
+            except Exception as e:
+                raise RuntimeError(
+                    f"python UDF "
+                    f"{getattr(self.func, '__name__', 'udf')!r} failed "
+                    f"on row {i}: {e}") from e
+        return HostColumn.from_values(self._rt, out)
+
+    def eval_host(self, batch: HostBatch) -> HostColumn:
+        cols = [as_host_column(c.eval_host(batch), batch)
+                for c in self._children]
+        return self._apply([c.to_list() for c in cols], batch.num_rows)
+
+    def eval(self, batch: DeviceBatch):
+        from spark_rapids_tpu.columnar.host import (
+            device_to_host, host_to_device)
+        cols = [as_device_column(c.eval(batch), batch)
+                for c in self._children]
+        hb = device_to_host(DeviceBatch(tuple(cols), batch.num_rows,
+                                        sel=batch.sel))
+        # The download compacts selection vectors; re-expand results to
+        # the batch's live positions so the column lines up row-for-row.
+        live = np.asarray(batch.row_mask()) if batch.sel is not None \
+            else None
+        out = self._apply([c.to_list() for c in hb.columns], hb.num_rows)
+        if live is not None:
+            data = np.zeros(batch.capacity, object) \
+                if self._rt.is_string else \
+                np.zeros(batch.capacity, self._rt.np_dtype)
+            validity = np.zeros(batch.capacity, np.bool_)
+            idx = np.nonzero(live)[0]
+            if self._rt.is_string:
+                data[:] = b""
+            data[idx] = out.data
+            validity[idx] = out.validity
+            out = HostColumn(self._rt, data, validity)
+        dev = host_to_device(HostBatch(("c",), [out]),
+                             capacity=batch.capacity)
+        return dev.columns[0]
+
+    def pretty(self) -> str:
+        return f"pyudf:{getattr(self.func, '__name__', 'udf')}"

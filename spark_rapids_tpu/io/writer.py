@@ -50,6 +50,7 @@ class DataFrameWriter:
         self._prepare_dir(path)
         phys = self._df._physical()
         ctx = ExecContext(self._df._session.conf)
+        ctx.cache["engine"] = "device" if phys.root_on_device else "host"
         root = phys.root
         names = tuple(n for n, _ in root.schema)
         n_parts = root.num_partitions(ctx)

@@ -1072,7 +1072,8 @@ class HashAggregateExec(Exec):
             for i in range(0, len(batches), self._CONSOLIDATE_CHUNK):
                 grp = batches[i:i + self._CONSOLIDATE_CHUNK]
                 if len(grp) == 1:
-                    nxt.append(stage(grp[0]))
+                    # Level >= 1 singletons are already merge outputs.
+                    nxt.append(stage(grp[0]) if level == 0 else grp[0])
                     continue
                 cap = bucket_capacity(sum(b.capacity for b in grp))
                 nxt.append(stage(jit_concat_batches(grp, cap)))
@@ -1107,6 +1108,7 @@ class HashAggregateExec(Exec):
         skip_key = f"aggskip:{id(self):x}"
         skip_ratio = float(ctx.conf.get(C.AGG_SKIP_PARTIAL_RATIO))
         can_skip = (self.mode == "partial" and skip_ratio < 1.0
+                    and getattr(self, "allow_partial_skip", True)
                     and self._rowskip_capable)
         # Memory guard: when buffered partials exceed this many rows of
         # capacity, consolidate early (mirrors the reference's iterative

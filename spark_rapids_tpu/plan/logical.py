@@ -668,6 +668,12 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.Length(rec(node[1]))
     if kind == "concat":
         return E.ConcatStrings(*[rec(x) for x in node[1]])
+    if kind == "pyudf":
+        from spark_rapids_tpu.exprs.pyudf import PythonUDF
+        _, func, rt, arg_cols, reason = node
+        return PythonUDF(func, rt,
+                         [resolve(a, schema) for a in arg_cols],
+                         reason or "")
     if kind == "coalesce":
         return E.Coalesce(*[rec(x) for x in node[1]])
     if kind == "when":

@@ -172,6 +172,12 @@ def tag_column(c: Column, conf: C.TpuConf, reasons: List[str],
                 "enable spark.rapids.sql.castStringToFloat.enabled")
     if kind in _HOST_ROUNDTRIP_EXPRS:
         notes.append(f"expression {kind} runs via a host roundtrip")
+    if kind == "pyudf":
+        fname = getattr(c.node[1], "__name__", "udf")
+        notes.append(
+            f"python UDF {fname!r} could not be compiled to native "
+            f"expressions ({c.node[4]}); runs via host roundtrip "
+            "(GpuArrowEvalPythonExec-style fallback)")
     if kind in _CONTEXTUAL_EXPRS:
         notes.append(f"expression {kind}: {_CONTEXTUAL_EXPRS[kind]}")
     for x in c.node[1:]:
@@ -720,7 +726,8 @@ class Planner:
             else:
                 fn = type(s.fn)(ref)
             ex_aggs.append(AggSpec(s.name, fn))
-        final, dev = self._two_stage(ex_group, ex_aggs, expand, want_dev)
+        final, dev = self._two_stage(ex_group, ex_aggs, expand, want_dev,
+                                     allow_partial_skip=False)
         # Drop the grouping id from the output.
         out = [(n, BoundReference(i, e.data_type()))
                for i, (n, e) in enumerate(ex_group[:nk])]
@@ -728,11 +735,15 @@ class Planner:
                 for i, s in enumerate(ex_aggs)]
         return ProjectExec(final, out), dev
 
-    def _two_stage(self, group_by, aggs, child,
-                   want_dev: bool) -> Tuple[Exec, bool]:
+    def _two_stage(self, group_by, aggs, child, want_dev: bool,
+                   allow_partial_skip: bool = True) -> Tuple[Exec, bool]:
         """partial -> hash exchange -> final (shared by plain and
-        grouping-set aggregates)."""
+        grouping-set aggregates). Grouping-set plans keep the partial
+        pass unconditionally: the expand multiplies rows N-fold, and the
+        coarse rollup levels reduce massively even when the finest level
+        does not — skipping would shuffle the whole expansion."""
         partial = HashAggregateExec(child, group_by, aggs, mode="partial")
+        partial.allow_partial_skip = allow_partial_skip
         nkeys = len(group_by)
         if nkeys:
             keys = [BoundReference(i, e.data_type())
@@ -830,20 +841,18 @@ class Planner:
                 strategy = "shuffle"
             else:
                 threshold = int(self.conf.get(C.AUTO_BROADCAST_THRESHOLD))
-                if threshold < 0:
-                    strategy = "broadcast"
-                else:
-                    from spark_rapids_tpu.plan.pruning import estimate_bytes
-                    build_plan = plan.children[1] \
-                        if plan.join_type != "right" else plan.children[0]
-                    est = estimate_bytes(build_plan)
-                    strategy = "broadcast" \
-                        if est is not None and est <= threshold \
-                        else "shuffle"
-                    meta.notes.append(
-                        f"auto join strategy -> {strategy} (build side "
-                        f"~{est if est is not None else '?'} bytes, "
-                        f"threshold {threshold})")
+                from spark_rapids_tpu.plan.pruning import estimate_bytes
+                build_plan = plan.children[1] \
+                    if plan.join_type != "right" else plan.children[0]
+                est = estimate_bytes(build_plan)
+                # Spark semantics: -1 disables auto-broadcast.
+                strategy = "broadcast" \
+                    if threshold >= 0 and est is not None \
+                    and est <= threshold else "shuffle"
+                meta.notes.append(
+                    f"auto join strategy -> {strategy} (build side "
+                    f"~{est if est is not None else '?'} bytes, "
+                    f"threshold {threshold})")
         if strategy == "broadcast":
             return BroadcastHashJoinExec(
                 lch, rch, lkeys, rkeys, plan.join_type, cond), want_dev

@@ -135,7 +135,16 @@ def estimate_bytes(plan: LogicalPlan) -> Optional[int]:
         for part in plan.partitions:
             for hb in part:
                 for c in hb.columns:
-                    total += c.num_rows * max(c.dtype.itemsize, 8)
+                    if c.dtype.is_string:
+                        if c.str_lengths is not None:
+                            total += int(c.str_lengths.sum()) + \
+                                4 * c.num_rows
+                        else:
+                            total += sum(
+                                len(b) if b is not None else 0
+                                for b in c.data) + 4 * c.num_rows
+                    else:
+                        total += c.num_rows * max(c.dtype.itemsize, 8)
         return total
     if isinstance(plan, L.LogicalRange):
         rows = max(0, -(-(plan.end - plan.start) // plan.step)) \

@@ -1,0 +1,25 @@
+"""UDF acceleration tier.
+
+The reference decompiles JVM lambda bytecode into Catalyst expression
+trees (udf-compiler/.../Instruction.scala:1, 830 LoC +
+CatalystExpressionBuilder.scala) so UDFs run as native GPU expressions,
+and falls back to Arrow-fed Python workers for the rest
+(sql-plugin/.../python/GpuArrowEvalPythonExec.scala:494,
+python/rapids/worker.py:22). This package is the TPU build's analog with
+Python as the host language: ``udf(f)`` walks the function's AST
+(udf/compiler.py) and translates a restricted subset — arithmetic,
+comparisons, boolean logic, conditionals, math/string builtins — into the
+engine's Column DSL, so a compiled UDF is indistinguishable from native
+expressions (full device execution, jit fusion, predicate pushdown).
+
+When compilation fails, the call still works: it produces a ``pyudf``
+expression that evaluates the original Python function over host-side
+column values with a device roundtrip (the GpuArrowEvalPythonExec
+pattern), and the planner's explain output carries the compile-failure
+reason (willNotWorkOnGpu-style visibility).
+"""
+
+from spark_rapids_tpu.udf.compiler import (
+    UdfCompileError, compile_udf, udf)
+
+__all__ = ["udf", "compile_udf", "UdfCompileError"]
