@@ -94,6 +94,38 @@ def _uniform_shards(batches_per_dev: List[List[DeviceBatch]],
     return out
 
 
+def _addressable_parts(out, n: int):
+    """Device i's post-exchange shard as an ordinary per-device batch.
+
+    Extracts each leaf's per-device shard via ``addressable_shards``
+    (device-local data, one tiny local slice per leaf) instead of ``x[i]``
+    gathers on the global sharded array — a cross-device lazy gather that
+    XLA re-dispatches whenever a consumer (including the range-bounds
+    sampling pass re-executing this tree) touches it, and the trigger of
+    the r4 SIGABRT inside apply_primitive (VERDICT r4 item 2).
+
+    The downstream operator stream is single-process and mixes partitions
+    freely (concat across buckets), so every shard is eagerly
+    ``device_put`` onto the default device — an explicit transfer now, not
+    a lazy gather later."""
+    leaves, treedef = jax.tree.flatten(out)
+    per_dev = [[] for _ in range(n)]
+    for leaf in leaves:
+        by_row = {}
+        for s in leaf.addressable_shards:
+            row = s.index[0].start or 0 if s.index else 0
+            by_row[row] = s.data
+        for i in range(n):
+            if i in by_row:
+                per_dev[i].append(by_row[i][0])
+            else:       # replicated / unsharded leaf: plain slice is local
+                per_dev[i].append(leaf[i])
+    # ONE batched transfer for every shard of every partition (device_put
+    # takes pytrees) — not a put per leaf per device.
+    per_dev = jax.device_put(per_dev, jax.devices()[0])
+    return [jax.tree.unflatten(treedef, ls) for ls in per_dev]
+
+
 class MeshExchangeExec(Exec):
     """Hash shuffle over the device mesh as one collective program."""
 
@@ -141,8 +173,7 @@ class MeshExchangeExec(Exec):
             if self._step is None:
                 self._step = self._build_step(mesh, n)
             out = self._step(stacked)
-        # Slice device i's post-exchange shard back out as partition i.
-        parts = [jax.tree.map(lambda x, i=i: x[i], out) for i in range(n)]
+            parts = _addressable_parts(out, n)
         ctx.cache[key] = parts
         return parts
 
