@@ -1,0 +1,72 @@
+"""Stage-by-stage q3 timing on the live backend: each stage is a prefix
+of the q3 pipeline ending in a cheap count, so stage N+1 minus stage N
+approximates the device cost of the added operator. Hot (scan cache on),
+second run of each stage is reported.
+
+Usage: TPCH_SF=1 python scripts/q3_stages.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.plan.logical import agg_count, agg_sum, col, \
+        lit_col
+
+    sf = float(os.environ.get("TPCH_SF", "1.0"))
+    d = os.environ.get("TPCH_DIR", f"/tmp/srt_tpch_sf{sf:g}")
+    tpch.generate(d, scale=sf)
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.hasNans", False)
+    if os.environ.get("SRT_SHUFFLE_PARTS"):
+        s.set("spark.rapids.sql.shuffle.partitions",
+              int(os.environ["SRT_SHUFFLE_PARTS"]))
+
+    def read(t):
+        return s.read.parquet(*tpch._paths(d, t))
+
+    def stages():
+        cust = read("customer") \
+            .filter(col("c_mktsegment") == lit_col("BUILDING")) \
+            .select("c_custkey")
+        orders = read("orders") \
+            .filter(col("o_orderdate") < lit_col(tpch.days("1995-03-15"))) \
+            .select("o_orderkey", "o_custkey", "o_orderdate",
+                    "o_shippriority")
+        li = read("lineitem") \
+            .filter(col("l_shipdate") > lit_col(tpch.days("1995-03-15"))) \
+            .select("l_orderkey", "l_extendedprice", "l_discount")
+        co = orders.join_on(cust, ["o_custkey"], ["c_custkey"])
+        j = li.join_on(co, ["l_orderkey"], ["o_orderkey"])
+        g = j.group_by("l_orderkey", "o_orderdate", "o_shippriority").agg(
+            agg_sum(col("l_extendedprice") * (1.0 - col("l_discount")))
+            .alias("revenue"))
+        full = g.order_by(col("revenue").desc(),
+                          col("o_orderdate").asc()).limit(10)
+        return [
+            ("scan_li", li.agg(agg_count().alias("n"))),
+            ("join1_co", co.agg(agg_count().alias("n"))),
+            ("join2", j.agg(agg_count().alias("n"))),
+            ("agg", g.agg(agg_count().alias("n"))),
+            ("full", full),
+        ]
+
+    prev = 0.0
+    for name, df in stages():
+        df.collect()                      # compile + cold
+        t0 = time.perf_counter()
+        out = df.collect()
+        dt = time.perf_counter() - t0
+        print(f"{name:10s} hot={dt:7.3f}s  delta={dt - prev:7.3f}s "
+              f"-> {out[:1]}")
+        prev = dt
+
+
+if __name__ == "__main__":
+    main()

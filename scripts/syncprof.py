@@ -1,0 +1,112 @@
+"""Host-sync profiler: on this tunneled chip a device->host read costs a
+~70ms round trip, so query wall time ~= device compute + 70ms * syncs.
+This wraps every sync funnel (jax.device_get, ArrayImpl.__array__ /
+__int__ / __float__ / __bool__) and attributes blocking time to the
+engine call site — the "where do the round trips come from" view that
+jax.profiler traces don't give on a remote backend.
+
+Usage: python scripts/syncprof.py [q1|q6|q3|q5|q67|xbb_q5|repart] [iters]
+Env: TPCH_SF (default 1.0), SYNCPROF_CPU=1 for the hermetic CPU backend.
+"""
+import collections
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("SYNCPROF_CPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax._src.xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")
+
+import jax  # noqa: E402
+
+_STATS = collections.defaultdict(lambda: [0, 0.0])   # site -> [count, secs]
+_ENABLED = [False]
+
+
+def _site() -> str:
+    """Innermost spark_rapids_tpu frame of the current stack."""
+    for f in reversed(traceback.extract_stack()):
+        if "spark_rapids_tpu" in f.filename and "syncprof" not in f.filename:
+            short = f.filename.split("spark_rapids_tpu/")[-1]
+            return f"{short}:{f.lineno} {f.name}"
+    return "<outside engine>"
+
+
+def _wrap(fn, label):
+    def wrapper(*a, **k):
+        if not _ENABLED[0]:
+            return fn(*a, **k)
+        t0 = time.perf_counter()
+        out = fn(*a, **k)
+        dt = time.perf_counter() - t0
+        s = _STATS[f"{label} @ {_site()}"]
+        s[0] += 1
+        s[1] += dt
+        return out
+    return wrapper
+
+
+def install():
+    from jax._src import array as _arr
+    jax.device_get = _wrap(jax.device_get, "device_get")
+    for m in ("__array__", "__int__", "__float__", "__bool__", "__index__"):
+        if hasattr(_arr.ArrayImpl, m):
+            setattr(_arr.ArrayImpl, m,
+                    _wrap(getattr(_arr.ArrayImpl, m), m))
+
+
+def report(wall: float):
+    total = sum(s[1] for s in _STATS.values())
+    n = sum(s[0] for s in _STATS.values())
+    print(f"\n  syncs: {n} totalling {total:.3f}s "
+          f"({100 * total / max(wall, 1e-9):.0f}% of wall)")
+    for site, (cnt, secs) in sorted(_STATS.items(), key=lambda kv: -kv[1][1]):
+        print(f"  {secs:8.3f}s  x{cnt:<5d} {site}")
+
+
+def main():
+    qn = sys.argv[1] if len(sys.argv) > 1 else "q3"
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+    install()
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import suites, tpch
+
+    sf = float(os.environ.get("TPCH_SF", "1.0"))
+    if qn in tpch.QUERIES:
+        mod, ddir = tpch, os.environ.get("TPCH_DIR", f"/tmp/srt_tpch_sf{sf:g}")
+    else:
+        mod, ddir = suites, os.environ.get("SUITES_DIR",
+                                           f"/tmp/srt_suites_sf{sf:g}")
+    mod.generate(ddir, scale=sf)
+
+    session = TpuSession()
+    session.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    session.set("spark.rapids.sql.hasNans", False)
+    if os.environ.get("SRT_SHUFFLE_PARTS"):
+        session.set("spark.rapids.sql.shuffle.partitions",
+                    int(os.environ["SRT_SHUFFLE_PARTS"]))
+    df = mod.QUERIES[qn](session, ddir)
+
+    t0 = time.perf_counter()
+    df.collect()
+    print(f"warmup: {time.perf_counter() - t0:.2f}s")
+
+    for it in range(iters):
+        _STATS.clear()
+        _ENABLED[0] = True
+        t0 = time.perf_counter()
+        rows = df.collect()
+        wall = time.perf_counter() - t0
+        _ENABLED[0] = False
+        print(f"\n=== {qn} iter {it}: wall {wall:.3f}s, {len(rows)} rows ===")
+        report(wall)
+
+
+if __name__ == "__main__":
+    main()

@@ -182,7 +182,14 @@ class DeviceBatch:
 
     @property
     def capacity(self) -> int:
-        return self.columns[0].capacity if self.columns else 0
+        if self.columns:
+            return self.columns[0].capacity
+        # A zero-column batch (count(*) over fully-pruned input) still
+        # carries liveness in its selection vector; its capacity is the
+        # sel length, not 0, or row_mask breaks against sel.
+        if self.sel is not None:
+            return int(self.sel.shape[0])
+        return 0
 
     @property
     def num_columns(self) -> int:
@@ -278,6 +285,60 @@ def jit_concat_batches(batches: Sequence[DeviceBatch],
         fn = jax.jit(lambda bs: concat_batches(bs, capacity))
         _JIT_CACHE[("concat", capacity)] = fn
     return fn(list(batches))
+
+
+def coalesce_iter(batches, target_rows: int, shrink: bool = False,
+                  target_bytes: int = 512 * 1024 * 1024):
+    """Group a batch stream into ~``target_rows``-capacity batches with
+    minimal host syncs (grouping keys off static capacities, the exchange
+    serving idiom — GpuCoalesceBatches.scala:115 done the TPU way).
+
+    Per-batch device work has a fixed floor on this chip (dispatch +
+    kernel latency ~tens of ms at any size), so streaming 8 scan-file
+    batches through a join probe or partial aggregate costs 8 floors
+    where one coalesced batch costs one + a single packed concat gather.
+
+    ``shrink=True`` additionally compacts sparse members first (one
+    batched sizes pull per group, skipped where rows_hint is known):
+    consumers whose kernels scale with CAPACITY (sort-based aggregation)
+    must not pay 4M-row sorts for a selective join's 30k live rows.
+
+    ``target_bytes`` bounds the coalesced device size as well — wide
+    (many-string-column) rows must not ride the row target into
+    multi-GB batches (the batchSizeBytes bound, GpuCoalesceBatches'
+    byte goal).
+    """
+    group: List[DeviceBatch] = []
+    group_cap = 0
+    group_bytes = 0
+
+    def flush():
+        g = group
+        if shrink:
+            g, _ = shrink_all(g)
+        if len(g) == 1:
+            return g[0]
+        cap = bucket_capacity(sum(b.capacity for b in g))
+        out = jit_concat_batches(g, cap)
+        hints = [b.rows_hint for b in g]
+        if all(h is not None for h in hints):
+            out.rows_hint = sum(hints)
+        return out
+
+    for b in batches:
+        bb = b.device_size_bytes()
+        if group and (group_cap + b.capacity > target_rows
+                      or group_bytes + bb > target_bytes):
+            yield flush()
+            group, group_cap, group_bytes = [], 0, 0
+        group.append(b)
+        group_cap += b.capacity
+        group_bytes += bb
+        if group_cap >= target_rows or group_bytes >= target_bytes:
+            yield flush()
+            group, group_cap, group_bytes = [], 0, 0
+    if group:
+        yield flush()
 
 
 def shrink_to_capacity(batch: DeviceBatch, capacity: int) -> DeviceBatch:

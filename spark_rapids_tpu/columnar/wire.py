@@ -50,7 +50,41 @@ from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
 # Column wire spec (static, hashable -- part of the decode jit cache key):
 #   numeric: ("num", logical_name, wire_np_name, vmode)
 #   string:  ("str", width, lengths_np_name, vmode)
+#   dict num: ("dnum", logical_name, code_np_name, dict_cap, vmode)
+#   dict str: ("dstr", width, code_np_name, dict_cap, vmode)
 # vmode: "all" (validity == row mask) | "packed" (bit-packed uint8).
+#
+# Dictionary encoding is the LZ4-of-this-wire (NvcompLZ4CompressionCodec
+# analog): XLA cannot run a byte-serial decompressor, but a gather from a
+# small value table is one exact fused kernel — and TPC-shaped data is
+# full of low-cardinality columns (flags, modes, quantities, discounts)
+# where an 8-byte float or an 8..32-byte string row ships as a 1-2 byte
+# code. Exactness: the gathered values ARE the host bit patterns (no
+# arithmetic), so emulated-f64 rounding never enters.
+
+_DICT_MAX = 4096            # value-table entries worth a table gather
+_DICT_SAMPLE = 1 << 16
+
+
+def _try_dict(values: np.ndarray, n: int):
+    """(codes, uniques) via pandas factorize when cardinality is low
+    enough to pay off, else None. Codes are -1-free (values prefiltered
+    for NaN; nulls were zeroed upstream)."""
+    if n == 0:
+        return None
+    if values.dtype.kind == "f":
+        v = values[:n]
+        # factorize hashes -0.0 == 0.0, which would drop the sign bit.
+        if not np.isfinite(v).all() or np.any((v == 0) & np.signbit(v)):
+            return None
+    sample = values[:min(n, _DICT_SAMPLE)]
+    if len(np.unique(sample)) > _DICT_MAX // 4:
+        return None
+    import pandas as pd
+    codes, uniques = pd.factorize(values[:n], sort=False)
+    if len(uniques) > _DICT_MAX:
+        return None
+    return codes, uniques
 
 _INT_CANDIDATES = (
     (np.int8, -128, 127),
@@ -108,7 +142,68 @@ def encode_column(hc, name: str, n: int, cap: int,
         varrs = [np.packbits(validity, bitorder="little")]
 
     if hc.dtype.is_string:
-        m, lens = strings_to_matrix(hc)
+        # Dictionary path first: a low-cardinality string column (flags,
+        # modes, segments) ships 1-2 byte codes + a tiny value table
+        # instead of a (rows x width) byte matrix. All probing runs on
+        # the dense byte MATRIX (never the lazy per-row object array):
+        # rows keyed as (big-endian length | content bytes) void scalars,
+        # compared bytewise by np.unique — fully vectorized.
+        m0, lens0 = strings_to_matrix(hc)
+        lens0 = np.where(hc.validity, lens0, 0).astype(np.int32)
+        mw = m0.shape[1]
+        d = None
+        if n:
+            keyed = np.zeros((n, mw + 4), np.uint8)
+            keyed[:, :4] = lens0.astype(">i4").view(np.uint8) \
+                .reshape(n, 4)
+            if mw:
+                keyed[:, 4:] = np.where(hc.validity[:, None],
+                                        m0[:n], 0)
+            key = np.ascontiguousarray(keyed).view(
+                [("k", f"V{mw + 4}")]).ravel()
+            if len(np.unique(key[:_DICT_SAMPLE])) <= _DICT_MAX // 4:
+                uniq, first_idx, codes = np.unique(
+                    key, return_index=True, return_inverse=True)
+                if len(uniq) <= _DICT_MAX:
+                    d = (codes, first_idx)
+        if d is not None:
+            codes, first_idx = d
+            k = len(first_idx)
+            ulens = lens0[first_idx]
+            want = dt.string_width_bucket(int(ulens.max()) if k else 0)
+            if string_widths and name in string_widths:
+                want = max(want, string_widths[name])
+            # The all-zero key (empty/invalid rows) is the code padding
+            # rows take; add one if the column had no empty strings.
+            zeros = np.flatnonzero(ulens == 0)
+            dict_rows = list(first_idx)
+            if zeros.size:
+                zero_code = int(zeros[0])
+            else:
+                dict_rows.append(None)
+                zero_code = k
+                k += 1
+            dict_cap = 8
+            while dict_cap < k:
+                dict_cap *= 2
+            table = np.zeros((dict_cap, want), dtype=np.uint8)
+            len_t = np.int16 if want <= 32767 else np.int32
+            len_table = np.zeros(dict_cap, dtype=len_t)
+            w = min(want, mw)
+            for i, ri in enumerate(dict_rows):
+                if ri is None:
+                    continue
+                if w:
+                    table[i, :w] = np.where(hc.validity[ri],
+                                            m0[ri, :w], 0)
+                len_table[i] = min(int(ulens[i]) if i < len(ulens)
+                                   else 0, want)
+            code_t = np.int8 if dict_cap <= 128 else np.int16
+            codes_arr = np.full(cap, zero_code, dtype=code_t)
+            codes_arr[:n] = codes
+            return [codes_arr, table, len_table] + varrs, \
+                ("dstr", want, np.dtype(code_t).name, dict_cap, vmode)
+        m, lens = m0, lens0
         lens = np.where(hc.validity, lens, 0)
         want = dt.string_width_bucket(int(lens.max()) if n else 0)
         if string_widths and name in string_widths:
@@ -138,6 +233,33 @@ def encode_column(hc, name: str, n: int, cap: int,
         if narrow is not None:
             wire = values.astype(narrow)
             wire_name = np.dtype(narrow).name
+    if wire.dtype.itemsize > 2:
+        # Dictionary beats the typed wire only when codes are narrower
+        # than the narrowed values (a 0.00..0.10 f64 discount ships int8).
+        d = _try_dict(values, n)
+        if d is not None:
+            codes, uniques = d
+            uniques = list(uniques)
+            zero = hc.dtype.np_dtype.type(0)
+            zero_code = next((i for i, u in enumerate(uniques)
+                              if u == zero and not (
+                                  isinstance(u, float)
+                                  and np.signbit(u))), None)
+            if zero_code is None:
+                uniques.append(zero)
+                zero_code = len(uniques) - 1
+            dict_cap = 8
+            while dict_cap < len(uniques):
+                dict_cap *= 2
+            code_t = np.int8 if dict_cap <= 128 else np.int16
+            if np.dtype(code_t).itemsize < wire.dtype.itemsize:
+                table = np.zeros(dict_cap, dtype=hc.dtype.np_dtype)
+                table[:len(uniques)] = uniques
+                codes_arr = np.full(cap, zero_code, dtype=code_t)
+                codes_arr[:n] = codes
+                return [codes_arr, table] + varrs, \
+                    ("dnum", hc.dtype.name, np.dtype(code_t).name,
+                     dict_cap, vmode)
     data = np.zeros(cap, dtype=wire.dtype)
     data[:n] = wire
     return [data] + varrs, ("num", hc.dtype.name, wire_name, vmode)
@@ -158,7 +280,34 @@ def _decode_fn(cap: int, specs: tuple):
         it = iter(arrays)
         row_mask = None
         cols = []
+
+        def valid_of(vmode):
+            nonlocal row_mask
+            if vmode == "packed":
+                return _unpack_validity(next(it), cap)
+            if row_mask is None:
+                row_mask = jnp.arange(cap, dtype=jnp.int32) < num_rows
+            return row_mask
+
         for spec in specs:
+            if spec[0] == "dnum":
+                _, logical_name, _code_name, _dict_cap, vmode = spec
+                logical = dt.type_named(logical_name)
+                codes = next(it).astype(jnp.int32)
+                table = next(it)
+                data = jnp.take(table, codes, axis=0, mode="clip")
+                cols.append(DeviceColumn(logical, data, valid_of(vmode)))
+                continue
+            if spec[0] == "dstr":
+                _, width, _code_name, _dict_cap, vmode = spec
+                codes = next(it).astype(jnp.int32)
+                table = next(it)
+                len_table = next(it).astype(jnp.int32)
+                data = jnp.take(table, codes, axis=0, mode="clip")
+                lengths = jnp.take(len_table, codes, axis=0, mode="clip")
+                cols.append(DeviceColumn(dt.STRING, data, valid_of(vmode),
+                                         lengths))
+                continue
             if spec[0] == "str":
                 _, width, _len_name, vmode = spec
                 data = next(it)

@@ -131,7 +131,8 @@ BATCH_SIZE_BYTES = conf("spark.rapids.sql.batchSizeBytes").doc(
 BATCH_SIZE_ROWS = conf("spark.rapids.sql.batchSizeRows").doc(
     "Target row capacity bucket for coalesced TPU batches (power of two). "
     "TPU addition: row capacity, not just bytes, is what bounds XLA "
-    "recompilation.").long(1 << 20)
+    "recompilation. Default favors few large batches: per-batch device "
+    "work has a fixed latency floor on a tunneled chip.").long(4 << 20)
 
 AUTO_BROADCAST_THRESHOLD = conf(
     "spark.rapids.sql.autoBroadcastJoinThreshold").doc(

@@ -291,8 +291,20 @@ def eval_exprs(exprs: Sequence[Expression],
                batch: DeviceBatch) -> DeviceBatch:
     """Project: evaluate expressions into a new device batch
     (GpuProjectExec's core, basicPhysicalOperators.scala:66)."""
-    cols = tuple(as_device_column(e.eval(batch), batch) for e in exprs)
-    return DeviceBatch(cols, batch.num_rows, sel=batch.sel)
+    return project_batch(
+        tuple(as_device_column(e.eval(batch), batch) for e in exprs),
+        batch)
+
+
+def project_batch(cols, batch: DeviceBatch) -> DeviceBatch:
+    """New batch of ``cols`` sharing ``batch``'s liveness. A ZERO-column
+    projection (count(*) pruning) must keep liveness in the selection
+    vector, or the batch's capacity/row count is unrecoverable from its
+    (empty) column shapes."""
+    sel = batch.sel
+    if not cols and sel is None:
+        sel = batch.row_mask()
+    return DeviceBatch(tuple(cols), batch.num_rows, sel=sel)
 
 
 def eval_exprs_host(exprs: Sequence[Expression], batch: HostBatch,

@@ -726,7 +726,8 @@ class HashAggregateExec(Exec):
                 cols.append(as_device_column(spec.fn.child.eval(batch),
                                              batch))
                 ords.append(len(cols) - 1)
-        return DeviceBatch(tuple(cols), batch.num_rows, sel=batch.sel), ords
+        from spark_rapids_tpu.exprs.base import project_batch
+        return project_batch(cols, batch), ords
 
     @staticmethod
     def _sorted_col(col: DeviceColumn, perm, slive) -> SortedCol:
@@ -1113,9 +1114,23 @@ class HashAggregateExec(Exec):
         # Memory guard: when buffered partials exceed this many rows of
         # capacity, consolidate early (mirrors the reference's iterative
         # re-merge loop, aggregate.scala:427 — but amortized, not
-        # per-batch).
-        consolidate_at = 8 * int(ctx.conf.get(C.BATCH_SIZE_ROWS))
-        for batch in self.children[0].execute_device(ctx, partition):
+        # per-batch). Deliberately NOT tied to batchSizeRows: that knob
+        # tunes coalescing, this one bounds buffered-state high water.
+        consolidate_at = max(8 << 20,
+                             2 * int(ctx.conf.get(C.BATCH_SIZE_ROWS)))
+        child_iter = self.children[0].execute_device(ctx, partition)
+        if update_stage and not self._global_ok:
+            # Coalesce the input stream: one sort-based update kernel over
+            # a 4M-row batch beats 8 over 512k (fixed per-dispatch floor),
+            # and sparse join outputs compact before the capacity-scaled
+            # sort. Zero-key aggregates skip this: their masked reductions
+            # don't sort, so the concat gather would be pure overhead.
+            from spark_rapids_tpu.columnar.batch import coalesce_iter
+            child_iter = coalesce_iter(
+                child_iter, int(ctx.conf.get(C.BATCH_SIZE_ROWS)),
+                shrink=True,
+                target_bytes=int(ctx.conf.get(C.BATCH_SIZE_BYTES)))
+        for batch in child_iter:
             saw_input = True
             if update_stage:
                 skipping = can_skip and ctx.cache.get(skip_key, False)

@@ -335,10 +335,14 @@ class LocalLimitExec(Exec):
             if remaining <= 0:
                 break
             out = batch.head(remaining)
-            # live count is a device scalar; pull it once per batch to
-            # advance the python-side budget (the sync the reference's
-            # limit also does)
-            taken = int(out.live_count())
+            # Advance the python-side budget. A host-known live count
+            # (sort/shuffle outputs carry rows_hint) avoids the device
+            # scalar pull the reference's limit pays per batch.
+            if batch.rows_hint is not None:
+                taken = min(batch.rows_hint, remaining)
+                out.rows_hint = taken
+            else:
+                taken = int(out.live_count())
             remaining -= taken
             yield out
 

@@ -471,6 +471,16 @@ class Planner:
         if self._mesh_enabled():
             from spark_rapids_tpu.parallel.mesh_exchange import mesh_size
             return mesh_size()
+        if self.conf.raw.get(C.SHUFFLE_PARTITIONS.key) is None:
+            # Defaulted count on a single chip: a materialized exchange
+            # only chunks work (all buckets run on device 0), and every
+            # extra partition costs downstream per-partition round trips
+            # (~70ms each on a tunneled link — the r4 q3 sync profile).
+            # One partition = one merge, fewest syncs. An explicit conf
+            # value or a multi-device mesh keeps the configured fan-out.
+            import jax
+            if len(jax.devices()) == 1:
+                return 1
         return self.conf.get(C.SHUFFLE_PARTITIONS)
 
     def _mesh_enabled(self) -> bool:

@@ -21,6 +21,17 @@ def roundtrip(dtype, values):
     return back.columns[0].to_list(), db
 
 
+
+
+def hi_card(base, dtype=None):
+    """Append >1024 distinct filler values so the dictionary path declines
+    and the typed-wire spec under test is the one chosen."""
+    import numpy as np
+    if dtype == "str":
+        return list(base) + [f"filler-{i}" for i in range(1200)]
+    return list(base) + [float(i) + 0.5 if dtype == "f" else (10 + i)
+                         for i in range(1200)]
+
 class TestWireRoundTrip:
     def test_int_narrowing_small(self):
         vals = [1, 2, None, 127, -128]
@@ -35,8 +46,11 @@ class TestWireRoundTrip:
         vals = [2 ** 40, -2 ** 40, None]
         out, _ = roundtrip(dt.INT64, vals)
         assert out == vals
+        vals = hi_card([2 ** 40, -2 ** 40, None])
+        vals += [v * 2 ** 30 for v in range(1300)]   # defeat int narrowing
         arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.INT64, vals), "x", 3, 8, None)
+            HostColumn.from_values(dt.INT64, vals), "x", len(vals), 4096,
+            None)
         assert spec[2] == "int64"
 
     def test_float_2dp_ships_exact(self):
@@ -48,8 +62,11 @@ class TestWireRoundTrip:
         vals = [1234.56, 0.01, None, -99.99, 0.07]
         out, _ = roundtrip(dt.FLOAT64, vals)
         assert out == vals
+        vals = hi_card(vals, "f")
+        vals = [None if v is None else v + 0.003 for v in vals]
         arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.FLOAT64, vals), "x", 5, 8, None)
+            HostColumn.from_values(dt.FLOAT64, vals), "x", len(vals),
+            4096, None)
         assert spec[2] == "float64"
 
     def test_float_whole_numbers(self):
@@ -73,34 +90,48 @@ class TestWireRoundTrip:
         # and corrupt the data silently).
         big = "x" * 40000
         vals = [big, "short", None]
-        arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.STRING, vals), "x", 3, 8, None)
-        assert spec[0] == "str" and spec[2] == "int32"
         out, _ = roundtrip(dt.STRING, vals)
         assert out == vals
+        # Dictionary path: int32 lengths survive the dict len-table too.
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.STRING, vals), "x", 3, 8, None)
+        assert spec[0] == "dstr" and spec[2] == "int8" and spec[1] > 32767
+        # Typed path (high cardinality): int32 wire lengths.
+        vals = hi_card([big, "short", None], "str")
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.STRING, vals), "x", len(vals), 4096,
+            None)
+        assert spec[0] == "str" and spec[2] == "int32"
 
     def test_negative_zero_preserved(self):
-        vals = [-0.0, 1.0, 2.0]
+        vals = hi_card([-0.0, 1.0, 2.0], "f")
         arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+            HostColumn.from_values(dt.FLOAT64, vals), "x", len(vals),
+            4096, None)
         # -0.0 disqualifies the scaled-int path (it would become +0.0).
         assert spec[2] in ("float64", "float32")
+        vals = [-0.0, 1.0, 2.0]
         out, _ = roundtrip(dt.FLOAT64, vals)
         assert np.signbit(np.float64(out[0]))
 
     def test_float_irrational_falls_back(self):
-        vals = [np.pi, np.e, 1/3]
+        vals = hi_card([np.pi, np.e, 1 / 3], "f")
+        vals = [v + 1 / 3 for v in vals]
         arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+            HostColumn.from_values(dt.FLOAT64, vals), "x", len(vals),
+            4096, None)
         assert spec[2] == "float64"
+        vals = [np.pi, np.e, 1 / 3]
         out, _ = roundtrip(dt.FLOAT64, vals)
         assert out == vals
 
     def test_f32_exact_representable(self):
-        vals = [0.5, 0.25, 1.0 + 2 ** -20]
+        vals = hi_card([0.5, 0.25, 1.0 + 2 ** -20], "f")
         arrs, spec = wire.encode_column(
-            HostColumn.from_values(dt.FLOAT64, vals), "x", 3, 8, None)
+            HostColumn.from_values(dt.FLOAT64, vals), "x", len(vals),
+            4096, None)
         assert spec[2] == "float32"
+        vals = [0.5, 0.25, 1.0 + 2 ** -20]
         out, _ = roundtrip(dt.FLOAT64, vals)
         assert out == vals
 
@@ -149,3 +180,51 @@ class TestWireRoundTrip:
         hb = HostBatch.from_pydict([("x", dt.INT32)], {"x": [1, 2, 3]})
         db = host_to_device(hb)
         assert db.rows_hint == 3
+
+
+class TestDictionaryWire:
+    """Low-cardinality columns ship as codes + a value table (the wire's
+    LZ4 stand-in: decode is ONE exact gather, no arithmetic)."""
+
+    def test_string_dict(self):
+        vals = (["MAIL", "SHIP", None, "AIR"] * 50)[:-1] + ["RAIL"]
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.STRING, vals), "x", len(vals), 256,
+            None)
+        assert spec[0] == "dstr"
+        out, _ = roundtrip(dt.STRING, vals)
+        assert out == vals
+
+    def test_float_dict_bit_exact(self):
+        base = [0.01 * i for i in range(11)] + [None]
+        vals = base * 20
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, vals), "x", len(vals), 512,
+            None)
+        assert spec[0] == "dnum" and spec[2] == "int8"
+        # -0.0 disqualifies the dict (factorize hashes it equal to +0.0).
+        arrs, spec = wire.encode_column(
+            HostColumn.from_values(dt.FLOAT64, [-0.0] + base[:-1] * 20),
+            "x", 221, 256, None)
+        assert spec[0] == "num"
+        out, _ = roundtrip(dt.FLOAT64, vals)
+        import numpy as np
+        for got, want in zip(out, vals):
+            if want is None:
+                assert got is None
+            else:
+                assert np.float64(got).tobytes() == \
+                    np.float64(want).tobytes()
+
+    def test_int_dict(self):
+        vals = ([2 ** 40, -2 ** 40, 7, None] * 40)
+        out, _ = roundtrip(dt.INT64, vals)
+        assert out == vals
+
+    def test_padding_rows_decode_to_zero(self):
+        vals = [5.5, 6.5]
+        hb = HostBatch.from_pydict([("x", dt.FLOAT64)], {"x": vals * 80})
+        db = host_to_device(hb)
+        import numpy as np
+        data = np.asarray(db.columns[0].data)
+        assert (data[160:] == 0).all()
