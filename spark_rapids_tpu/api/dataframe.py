@@ -300,6 +300,95 @@ class DataFrame:
         rows = self.collect()
         return pd.DataFrame(rows, columns=self.columns)
 
+    def to_jax(self):
+        """ML hand-off: run the plan on the device engine and return the
+        result as ``{column_name: jax.Array}`` WITHOUT a host round trip
+        (ColumnarRdd.scala:41-49 / InternalColumnarRddConverter analog —
+        the reference exports cuDF device tables to GPU ML; here the
+        arrays stay resident in HBM for jax models to consume).
+
+        Numeric/bool/date columns come back as 1-D arrays of exactly the
+        live row count; strings as (rows, width) uint8 byte matrices
+        under ``name`` plus ``name + '__len'`` length vectors. Nulls are
+        not representable in a raw array — columns with any null raise
+        (fill or drop them in the query first)."""
+        import jax as _jax
+        import jax.numpy as jnp
+        import spark_rapids_tpu.config as C
+        from spark_rapids_tpu.columnar.batch import (
+            bucket_capacity, concat_batches)
+        from spark_rapids_tpu.memory.oom import set_active_catalog
+        from spark_rapids_tpu.memory.stores import get_tpu_semaphore
+        from spark_rapids_tpu.ops.base import ExecContext
+        phys = self._physical()
+        assert phys.root_on_device, \
+            "to_jax needs a device plan (sql.enabled off?)"
+        ctx = ExecContext(phys.conf)
+        ctx.cache.setdefault("engine", "device")
+        root = phys.root
+        # Same device-admission + OOM-recovery regime as collect():
+        # the semaphore bounds concurrent device users, the registered
+        # catalog lets dispatch sites spill-and-retry.
+        sem = get_tpu_semaphore(
+            max(int(phys.conf.get(C.CONCURRENT_TPU_TASKS)), 1))
+        try:
+            with sem:
+                set_active_catalog(ctx.catalog)
+                try:
+                    batches = []
+                    for p in range(root.num_partitions(ctx)):
+                        batches.extend(root.execute_device(ctx, p))
+                    if not batches:
+                        return self._empty_jax(root.schema)
+                    single = batches[0] if len(batches) == 1 else \
+                        concat_batches(
+                            batches, bucket_capacity(
+                                sum(b.capacity for b in batches)))
+                    from spark_rapids_tpu.columnar.rowmove import \
+                        compact_batch
+                    single = _jax.jit(compact_batch)(single)
+                    n = int(single.live_count())
+                finally:
+                    set_active_catalog(None)
+        finally:
+            phys.last_ctx = ctx
+            ctx.close()
+        out = {}
+        for (name, t), c in zip(root.schema, single.columns):
+            if not bool(jnp.all(c.validity[:n])):
+                raise ValueError(
+                    f"to_jax: column {name!r} contains nulls; fill or "
+                    f"filter them before exporting")
+            if t.is_string:
+                out[name] = c.data[:n]
+                out[name + "__len"] = c.lengths[:n]
+            else:
+                out[name] = c.data[:n]
+        return out
+
+    @staticmethod
+    def _empty_jax(schema):
+        """Typed empty export: dtypes and the string matrix/length layout
+        must match the non-empty contract."""
+        import jax.numpy as jnp
+        out = {}
+        for name, t in schema:
+            if t.is_string:
+                out[name] = jnp.zeros((0, 8), jnp.uint8)
+                out[name + "__len"] = jnp.zeros((0,), jnp.int32)
+            else:
+                out[name] = jnp.zeros((0,), t.np_dtype)
+        return out
+
+    def metrics(self):
+        """Per-operator metrics of the LAST collect() on this DataFrame
+        (GpuExec.scala:27-56 registry; empty before any action)."""
+        phys = self._physical()
+        ctx = getattr(phys, "last_ctx", None)
+        if ctx is None:
+            return {}
+        return {k: dict(m.values) for k, m in ctx.metrics.items()}
+
     # -- writes ---------------------------------------------------------------
     @property
     def write(self):

@@ -284,7 +284,8 @@ def jit_concat_batches(batches: Sequence[DeviceBatch],
     if fn is None:
         fn = jax.jit(lambda bs: concat_batches(bs, capacity))
         _JIT_CACHE[("concat", capacity)] = fn
-    return fn(list(batches))
+    from spark_rapids_tpu.memory.oom import retry_on_oom
+    return retry_on_oom(fn, list(batches))
 
 
 def coalesce_iter(batches, target_rows: int, shrink: bool = False,

@@ -360,15 +360,22 @@ def encode_batch(batch, capacity: Optional[int] = None,
 
 
 def upload_encoded(arrays, specs, n: int, cap: int) -> DeviceBatch:
-    """Device-side half: single device_put + jitted on-device widen."""
-    put = jax.device_put(arrays)
-    dev_arrays, num_rows = put[:-1], put[-1]
-    key = (cap, specs)
-    fn = _DECODE_JIT_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(_decode_fn(cap, specs))
-        _DECODE_JIT_CACHE[key] = fn
-    out = fn(dev_arrays, num_rows)
+    """Device-side half: single device_put + jitted on-device widen.
+    The largest single allocations in the engine happen here, so the
+    dispatch runs under OOM->spill->retry (memory/oom.py)."""
+    from spark_rapids_tpu.memory.oom import retry_on_oom
+
+    def put_and_decode():
+        put = jax.device_put(arrays)
+        dev_arrays, num_rows = put[:-1], put[-1]
+        key = (cap, specs)
+        fn = _DECODE_JIT_CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(_decode_fn(cap, specs))
+            _DECODE_JIT_CACHE[key] = fn
+        return fn(dev_arrays, num_rows)
+
+    out = retry_on_oom(put_and_decode)
     out.rows_hint = n
     return out
 

@@ -263,9 +263,16 @@ SHUFFLE_PARTITIONS = conf("spark.rapids.sql.shuffle.partitions").doc(
 HBM_POOL_FRACTION = conf("spark.rapids.memory.tpu.allocFraction").doc(
     "Fraction of visible HBM the engine budgets for batch storage; the "
     "watermark evictor starts spilling above it (ref: RMM pool + "
-    "DeviceMemoryEventHandler). Conservative default: the tunneled chip "
-    "reports no memory stats, and compute transients live outside the "
-    "budget.").double(0.6)
+    "DeviceMemoryEventHandler). A real allocation failure past the "
+    "watermark spills-and-retries at the dispatch site (memory/oom.py), "
+    "so the budget can run close to full.").double(0.9)
+
+MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
+    "Log every catalog buffer add/acquire/spill/remove with sizes, record "
+    "creation stacks, and emit a leak report (unfreed buffers + where "
+    "they were allocated) when the query context closes (ref: "
+    "spark.rapids.memory.gpu.debug, RapidsConf.scala:288 + cuDF "
+    "MemoryCleaner leak callstacks).").boolean(False)
 
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
     "Bytes of host RAM for spilled device batches before going to disk."

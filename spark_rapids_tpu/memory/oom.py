@@ -1,0 +1,53 @@
+"""OOM -> spill -> retry at dispatch boundaries
+(DeviceMemoryEventHandler.scala:42-69 re-imagined for XLA).
+
+The reference installs a cuDF alloc-failure callback that spills the
+RapidsBufferCatalog and lets RMM retry the SAME allocation. XLA exposes no
+allocator hook, so the equivalent lives at the dispatch sites instead:
+the handful of funnels that issue large device allocations (uploads,
+concats/shrinks, downloads) run through :func:`retry_on_oom`, which
+catches the backend's RESOURCE_EXHAUSTED, spills every spillable catalog
+buffer to the host tier, and retries the dispatch exactly once. The
+wrapped operations are pure batch->batch (no consumed iterator state), so
+the retry is safe.
+
+The active catalog is registered per-collect (ops/base.py) — dispatch
+sites deep in the kernel layer never thread an ExecContext through.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+_local = threading.local()
+
+
+def set_active_catalog(catalog) -> None:
+    _local.catalog = catalog
+
+
+def get_active_catalog():
+    return getattr(_local, "catalog", None)
+
+
+def is_oom_error(e: BaseException) -> bool:
+    s = f"{type(e).__name__}: {e}"
+    return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
+            or "out of memory" in s or "OOM" in s)
+
+
+def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:
+    """Run ``fn``; on a device OOM, spill the active catalog and retry
+    once. Anything else (or OOM with nothing spillable) propagates."""
+    try:
+        return fn(*args, **kwargs)
+    except Exception as e:                  # jaxlib.XlaRuntimeError etc.
+        if not is_oom_error(e):
+            raise
+        catalog = get_active_catalog()
+        if catalog is None or catalog.handle_oom() == 0:
+            raise
+        return fn(*args, **kwargs)

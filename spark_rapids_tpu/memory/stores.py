@@ -18,8 +18,11 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import itertools
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger("spark_rapids_tpu.memory")
 
 import numpy as np
 
@@ -127,7 +130,8 @@ class BufferCatalog:
     def __init__(self, device_budget_bytes: int = 1 << 34,
                  host_budget_bytes: int = 1 << 30,
                  spill_dir: str = "/tmp/spark_rapids_tpu_spill",
-                 compression_codec: str = "none"):
+                 compression_codec: str = "none",
+                 debug: bool = False):
         from spark_rapids_tpu.memory.compression import get_codec
         from spark_rapids_tpu.memory.native import open_spill_file
         self.device_budget = device_budget_bytes
@@ -138,6 +142,11 @@ class BufferCatalog:
         self._host_bytes = 0
         self._lock = threading.RLock()
         self._spill_file = open_spill_file(spill_dir)
+        # Alloc/leak debug (spark.rapids.memory.gpu.debug analog): log
+        # every buffer event and keep creation stacks for the close-time
+        # leak report.
+        self.debug = debug
+        self._stacks: Dict[int, str] = {}
         # Disk-tier blobs compress through the codec SPI
         # (spark.rapids.shuffle.compression.codec; TableCompressionCodec
         # analog — see memory/compression.py).
@@ -157,6 +166,12 @@ class BufferCatalog:
                 bid, StorageTier.DEVICE, size, priority,
                 device_batch=batch)
             self._device_bytes += size
+            if self.debug:
+                import traceback
+                self._stacks[bid] = "".join(
+                    traceback.format_stack(limit=8)[:-1])
+                _LOG.info("catalog add id=%d size=%d device_bytes=%d",
+                          bid, size, self._device_bytes)
             return bid
 
     def acquire_batch(self, buffer_id: int) -> DeviceBatch:
@@ -207,12 +222,37 @@ class BufferCatalog:
             e = self._entries.pop(buffer_id, None)
             if e is None:
                 return
+            if self.debug:
+                self._stacks.pop(buffer_id, None)
+                _LOG.info("catalog remove id=%d size=%d", buffer_id,
+                          e.size_bytes)
             if e.tier == StorageTier.DEVICE:
                 self._device_bytes -= e.size_bytes
             elif e.tier == StorageTier.HOST:
                 self._host_bytes -= e.size_bytes
             elif e.disk_block is not None:
                 self._spill_file.free(e.disk_block)
+
+    # -- OOM recovery --------------------------------------------------------
+    def handle_oom(self) -> int:
+        """Real HBM allocation failure (not a budget watermark): spill
+        EVERY spillable device buffer to host and report bytes freed
+        (DeviceMemoryEventHandler.scala:42-69's alloc-failure callback,
+        driven from the dispatch site instead of a cuDF hook). Returns 0
+        when nothing was spillable — the caller's retry would just fail
+        again, so it should re-raise."""
+        freed = 0
+        with self._lock:
+            while True:
+                victim = self._pick_victim(StorageTier.DEVICE)
+                if victim is None:
+                    break
+                freed += victim.size_bytes
+                self._spill_device_to_host(victim)
+        if freed:
+            self.metrics["oom_spills"] = \
+                self.metrics.get("oom_spills", 0) + 1
+        return freed
 
     # -- spilling ------------------------------------------------------------
     def _ensure_device_room(self, incoming: int):
@@ -284,7 +324,26 @@ class BufferCatalog:
     def disk_bytes(self) -> int:
         return self._spill_file.allocated_bytes
 
+    def leak_report(self) -> List[Tuple[int, int, str]]:
+        """Buffers still registered: (id, bytes, creation stack) — the
+        MemoryCleaner leak-callstack analog. Stacks are recorded only in
+        debug mode."""
+        with self._lock:
+            return [(e.buffer_id, e.size_bytes,
+                     self._stacks.get(e.buffer_id, "<enable "
+                                      "spark.rapids.memory.tpu.debug for "
+                                      "the allocation stack>"))
+                    for e in self._entries.values()]
+
     def close(self):
+        leaks = self.leak_report()
+        if leaks and self.debug:
+            total = sum(b for _, b, _ in leaks)
+            _LOG.warning("catalog closing with %d leaked buffers "
+                         "(%d bytes):", len(leaks), total)
+            for bid, size, stack in leaks:
+                _LOG.warning("  leaked id=%d size=%d\n%s", bid, size,
+                             stack)
         self._spill_file.close()
 
 

@@ -1133,14 +1133,16 @@ class HashAggregateExec(Exec):
         for batch in child_iter:
             saw_input = True
             if update_stage:
+                from spark_rapids_tpu.memory.oom import retry_on_oom
                 skipping = can_skip and ctx.cache.get(skip_key, False)
                 with timed(m):
                     if skipping:
-                        partial = passthrough(
+                        partial = retry_on_oom(
+                            passthrough,
                             batch, jnp.asarray(offset, jnp.int64))
                     else:
-                        partial = update(
-                            batch, jnp.asarray(offset, jnp.int64))
+                        partial = retry_on_oom(
+                            update, batch, jnp.asarray(offset, jnp.int64))
                 if can_skip and skip_key not in ctx.cache:
                     groups, live = _jax.device_get(
                         [partial.num_rows, batch.live_count()])

@@ -30,10 +30,13 @@ Schema = Tuple[Tuple[str, DataType], ...]
 
 
 class Metrics:
-    """Per-operator metric registry (NvtxWithMetrics analog, minus NVTX —
-    the tracing module attaches jax.profiler ranges instead)."""
+    """Per-operator metric registry (NvtxWithMetrics analog — ``timed``
+    additionally opens a named ``jax.profiler.TraceAnnotation`` so a
+    profile of a query shows per-operator ranges, NvtxWithMetrics.scala:
+    21-44)."""
 
-    def __init__(self):
+    def __init__(self, owner: str = ""):
+        self.owner = owner
         self.values: Dict[str, float] = {}
 
     def add(self, name: str, amount: float):
@@ -58,7 +61,7 @@ class ExecContext:
     def metrics_for(self, op: "Exec") -> Metrics:
         key = f"{type(op).__name__}@{id(op):x}"
         if key not in self.metrics:
-            self.metrics[key] = Metrics()
+            self.metrics[key] = Metrics(owner=type(op).__name__)
         return self.metrics[key]
 
     @property
@@ -80,7 +83,8 @@ class ExecContext:
                     self.conf.get(C.HOST_SPILL_STORAGE_SIZE)),
                 spill_dir=str(self.conf.get(C.SPILL_DIR)),
                 compression_codec=str(
-                    self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)))
+                    self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)),
+                debug=bool(self.conf.get(C.MEMORY_DEBUG)))
         return self._catalog
 
     def close(self):
@@ -158,10 +162,17 @@ class Exec:
             sem = get_tpu_semaphore(
                 max(int(ctx.conf.get(C.CONCURRENT_TPU_TASKS)), 1))
             with sem:
-                batches: List[DeviceBatch] = []
-                for p in range(self.num_partitions(ctx)):
-                    batches.extend(self.execute_device(ctx, p))
-                host_batches = download_batches(batches, names)
+                # OOM->spill->retry needs the catalog reachable from
+                # dispatch sites deep in the kernel layer (memory/oom.py).
+                from spark_rapids_tpu.memory.oom import set_active_catalog
+                set_active_catalog(ctx.catalog)
+                try:
+                    batches: List[DeviceBatch] = []
+                    for p in range(self.num_partitions(ctx)):
+                        batches.extend(self.execute_device(ctx, p))
+                    host_batches = download_batches(batches, names)
+                finally:
+                    set_active_catalog(None)
             # Row materialization is pure host CPU — outside the permit,
             # like the reference releasing GpuSemaphore once the task
             # leaves the device.
@@ -251,13 +262,21 @@ class HostToDeviceExec(Exec):
 
 
 def timed(metrics: Metrics, name: str = "totalTime"):
-    """Context manager adding elapsed ns to a metric (NvtxWithMetrics.scala
-    analog)."""
+    """Context manager adding elapsed ns to a metric AND opening a
+    ``jax.profiler.TraceAnnotation`` named ``<Op>:<metric>`` — a captured
+    profile (jax.profiler.trace) shows every operator's dispatch ranges
+    (NvtxWithMetrics.scala:21-44 analog)."""
+    import jax.profiler as _prof
+
     class _Timer:
         def __enter__(self):
+            self._ann = _prof.TraceAnnotation(
+                f"{metrics.owner or 'op'}:{name}")
+            self._ann.__enter__()
             self.t0 = time.perf_counter_ns()
 
         def __exit__(self, *exc):
             metrics.add(name, time.perf_counter_ns() - self.t0)
+            self._ann.__exit__(None, None, None)
             return False
     return _Timer()

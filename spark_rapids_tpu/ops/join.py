@@ -481,18 +481,21 @@ class _JoinKernelMixin:
         if mr is not None and jt in ("inner", "left", "right", "semi",
                                      "anti") and jittable:
             _maybe_build_dense(built, built.batch, built.key_ordinals)
+        from spark_rapids_tpu.memory.oom import retry_on_oom
         if built.table is not None:
             dense = self._dense_jit_fn()
             for pbatch in probe_iter:
-                yield dense(built, pbatch, probe_keys=tuple(probe_keys),
-                            build_is_right=build_is_right)
+                yield retry_on_oom(
+                    dense, built, pbatch, probe_keys=tuple(probe_keys),
+                    build_is_right=build_is_right)
             return
         fast = mr is not None and 0 < mr <= self._FAST_PATH_MAX_RUN
         for pbatch in probe_iter:
             if fast:
                 out_cap = bucket_capacity(max(pbatch.capacity * mr, 1))
                 if jittable:
-                    out, covered = self._probe_jit_fn()(
+                    out, covered = retry_on_oom(
+                        self._probe_jit_fn(),
                         built, pbatch, out_cap=out_cap,
                         build_is_right=build_is_right,
                         probe_keys=tuple(probe_keys))

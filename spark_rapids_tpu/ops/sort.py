@@ -61,9 +61,53 @@ def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder],
     return batch.gather(perm, batch.live_count())
 
 
+class _SpillableListSource(Exec):
+    """Leaf serving an already-buffered list of catalog-registered batches
+    (the sort's out-of-core staging area)."""
+
+    def __init__(self, schema: Schema, spillables):
+        super().__init__()
+        self._schema = tuple(schema)
+        self._spillables = spillables
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self, ctx) -> int:
+        # One partition per buffered batch: the exchange's range-bounds
+        # sampler reads 64 rows from EVERY partition's first batch, so
+        # this shape samples the whole staged input, not just its head.
+        return len(self._spillables)
+
+    def execute_device(self, ctx, partition):
+        from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
+        sb = self._spillables[partition]
+        try:
+            yield sb.get()
+        finally:
+            # Consumers abandon this generator mid-stream (the range
+            # bounds sampler breaks after one batch); the staged entry
+            # must drop back to spillable either way, or the whole
+            # larger-than-HBM input ends up pinned ACTIVE.
+            sb.release(PRIORITY_SHUFFLE_OUTPUT)
+
+    def execute_host(self, ctx, partition):    # pragma: no cover
+        raise AssertionError("device-only staging source")
+
+
 class SortExec(Exec):
     """Per-partition full sort (global order requires a range exchange
-    upstream, as in Spark)."""
+    upstream, as in Spark).
+
+    OUT-OF-CORE (beyond the reference's v0.3 RequireSingleBatch,
+    GpuSortExec.scala:50 — SURVEY §5.7's "thing to beat"): input batches
+    buffer as catalog-registered spillables; when the partition exceeds a
+    fraction of the device budget, the sort becomes a device sample-sort —
+    range-split the input through the exchange machinery into B spillable
+    buckets of bounded size, then sort each bucket independently and
+    stream them in range order. Peak HBM is one bucket + one in-flight
+    batch; the rest rides the host/disk spill tiers."""
 
     def __init__(self, child: Exec, orders: Sequence[SortOrder]):
         super().__init__(child)
@@ -74,23 +118,62 @@ class SortExec(Exec):
     def schema(self) -> Schema:
         return self.children[0].schema
 
-    def execute_device(self, ctx, partition):
+    def _sort_fn(self, ctx):
         from spark_rapids_tpu import config as C
-        m = ctx.metrics_for(self)
-        batches = list(self.children[0].execute_device(ctx, partition))
-        if not batches:
-            return
-        single = coalesce_to_single_batch(batches)
         stable = bool(ctx.conf.get(C.STABLE_SORT))
         if self._jit is None and all(o.child.jittable for o in self.orders):
             self._jit = jax.jit(
                 lambda b: sort_batch(b, self.orders, stable=stable))
-        fn = self._jit or (lambda b: sort_batch(b, self.orders,
-                                                stable=stable))
-        with timed(m):
-            out = fn(single)
-        m.add("numOutputBatches", 1)
-        yield out
+        return self._jit or (lambda b: sort_batch(b, self.orders,
+                                                  stable=stable))
+
+    def execute_device(self, ctx, partition):
+        from spark_rapids_tpu.memory.stores import (
+            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+        m = ctx.metrics_for(self)
+        spillables = []
+        total_bytes = 0
+        for b in self.children[0].execute_device(ctx, partition):
+            total_bytes += b.device_size_bytes()
+            spillables.append(SpillableBatch(ctx.catalog, b,
+                                             PRIORITY_SHUFFLE_OUTPUT))
+        if not spillables:
+            return
+        fn = self._sort_fn(ctx)
+        bucket_budget = max(ctx.catalog.device_budget // 3, 1 << 20)
+        from spark_rapids_tpu.memory.oom import retry_on_oom
+        if total_bytes <= bucket_budget:
+            batches = [sb.get() for sb in spillables]
+            single = coalesce_to_single_batch(batches)
+            for sb in spillables:
+                sb.close()
+            with timed(m):
+                out = retry_on_oom(fn, single)
+            m.add("numOutputBatches", 1)
+            yield out
+            return
+        # Sample-sort: range-split into B ~bucket_budget buckets via the
+        # exchange (its sizes-then-split path, spillable pieces, and
+        # range-bounds sampling are exactly what this phase needs).
+        from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+        from spark_rapids_tpu.parallel.partitioning import RangePartitioning
+        nb = max(2, -(-total_bytes // bucket_budget))
+        m.add("outOfCoreBuckets", nb)
+        src = _SpillableListSource(self.schema, spillables)
+        ex = ShuffleExchangeExec(src, RangePartitioning(self.orders, nb))
+        try:
+            for p in range(nb):
+                bucket = list(ex.execute_device(ctx, p))
+                if not bucket:
+                    continue
+                with timed(m):
+                    out = retry_on_oom(
+                        fn, coalesce_to_single_batch(bucket))
+                m.add("numOutputBatches", 1)
+                yield out
+        finally:
+            for sb in spillables:
+                sb.close()
 
     def execute_host(self, ctx, partition):
         hbs = list(self.children[0].execute_host(ctx, partition))

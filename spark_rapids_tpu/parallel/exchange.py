@@ -283,13 +283,22 @@ class ShuffleExchangeExec(Exec):
                     buckets[p].append(SpillableBatch(
                         ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
 
+        # The window is bounded by BYTES as well as count: pre-split
+        # batches are pinned un-spillable HBM, so a window must never
+        # hold more than a fraction of the device budget (out-of-core
+        # sorts/aggregations stream through here at multiples of HBM).
+        max_window_bytes = max(ctx.catalog.device_budget // 4, 1 << 20)
         window: List[DeviceBatch] = []
+        window_bytes = 0
         for cp in range(self.children[0].num_partitions(ctx)):
             for b in self.children[0].execute_device(ctx, cp):
                 window.append(b)
-                if len(window) >= _WINDOW:
+                window_bytes += b.device_size_bytes()
+                if len(window) >= _WINDOW or \
+                        window_bytes >= max_window_bytes:
                     flush_window(window)
                     window = []
+                    window_bytes = 0
         if window:
             flush_window(window)
         ctx.cache[key] = buckets

@@ -415,6 +415,8 @@ class PhysicalPlan:
         try:
             return self.root.collect(ctx, device=self.root_on_device)
         finally:
+            # Metrics survive the collect for DataFrame.metrics().
+            self.last_ctx = ctx
             if owned:
                 ctx.close()
 

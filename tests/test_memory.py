@@ -146,3 +146,62 @@ class TestSemaphore:
         for t in threads:
             t.join()
         assert max(peak) <= 2
+
+
+class TestOomRetry:
+    """OOM -> spill -> retry (DeviceMemoryEventHandler.scala:42-69
+    analog, memory/oom.py): a RESOURCE_EXHAUSTED dispatch spills every
+    spillable catalog buffer and re-runs the dispatch once."""
+
+    def test_retry_after_spill(self, tmp_path):
+        from spark_rapids_tpu.memory.oom import (retry_on_oom,
+                                                 set_active_catalog)
+        cat = BufferCatalog(device_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        bid = cat.add_batch(make_batch(1))
+        cat.release(bid)
+        set_active_catalog(cat)
+        try:
+            calls = []
+
+            def flaky():
+                calls.append(1)
+                if len(calls) == 1:
+                    raise RuntimeError(
+                        "RESOURCE_EXHAUSTED: Out of memory allocating "
+                        "12345 bytes")
+                return "ok"
+
+            assert retry_on_oom(flaky) == "ok"
+            assert len(calls) == 2
+            assert cat._entries[bid].tier == StorageTier.HOST
+            assert cat.metrics.get("oom_spills") == 1
+            # The spilled batch restores transparently.
+            back = device_to_host(cat.acquire_batch(bid), ("a", "s"))
+            assert back.num_rows == 64
+        finally:
+            set_active_catalog(None)
+
+    def test_non_oom_propagates(self):
+        from spark_rapids_tpu.memory.oom import retry_on_oom
+
+        def bad():
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            retry_on_oom(bad)
+
+    def test_oom_with_nothing_spillable_reraises(self, tmp_path):
+        from spark_rapids_tpu.memory.oom import (retry_on_oom,
+                                                 set_active_catalog)
+        cat = BufferCatalog(device_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        set_active_catalog(cat)
+        try:
+            def oom():
+                raise RuntimeError("RESOURCE_EXHAUSTED")
+
+            with pytest.raises(RuntimeError):
+                retry_on_oom(oom)
+        finally:
+            set_active_catalog(None)
