@@ -57,6 +57,14 @@ class TpuSession:
         return DataFrame(self, L.LogicalRange(start, end, step,
                                               num_partitions))
 
+    def ingest_spark_plan(self, plan_text: str, table_paths):
+        """Plugin mode: parse a CAPTURED Spark physical plan (the text of
+        ``df.explain()`` from a real cluster) and run it on this engine.
+        ``table_paths`` maps table names (matched against the captured
+        scan locations) to local data paths. See plan/spark_ingest.py."""
+        from spark_rapids_tpu.plan.spark_ingest import ingest_spark_plan
+        return ingest_spark_plan(plan_text, self, table_paths)
+
     @property
     def read(self) -> "DataFrameReader":
         return DataFrameReader(self)
@@ -123,6 +131,58 @@ class GroupedData:
     def count(self) -> "DataFrame":
         from spark_rapids_tpu.plan.logical import agg_count
         return self.agg(agg_count().alias("count"))
+
+    # -- pandas-UDF flavors (GpuFlatMapGroupsInPandasExec family) ---------
+    def _key_names(self) -> List[str]:
+        names = []
+        for hint, c in self._keys:
+            if c.node[0] != "ref":
+                raise ValueError(
+                    "pandas group flavors need plain column-name keys")
+            names.append(c.node[1])
+        return names
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(group: pandas.DataFrame) -> pandas.DataFrame, one call per
+        group (Spark applyInPandas; GpuFlatMapGroupsInPandasExec)."""
+        plan = L.LogicalGroupedMapInPandas(
+            self._df._plan, self._key_names(), fn, tuple(schema))
+        return DataFrame(self._df._session, plan)
+
+    applyInPandas = apply_in_pandas
+
+    def agg_in_pandas(self, **named) -> "DataFrame":
+        """GROUPED_AGG pandas UDFs: each kwarg is
+        ``out_name=(input_column, series_fn, result_type)`` where
+        series_fn(pandas.Series) -> scalar (GpuAggregateInPandasExec)."""
+        aggs = [(out, colname, fn, t)
+                for out, (colname, fn, t) in named.items()]
+        plan = L.LogicalAggInPandas(self._df._plan, self._key_names(),
+                                    aggs)
+        return DataFrame(self._df._session, plan)
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        return CoGroupedData(self, other)
+
+
+class CoGroupedData:
+    """Pair of grouped frames for cogrouped pandas application
+    (Spark's PandasCogroupedOps; GpuCoGroupedMapInPandasExec)."""
+
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(left_group: pdf, right_group: pdf) -> pdf per key in the
+        union of both sides' key sets (absent side = empty frame)."""
+        plan = L.LogicalCoGroupedMapInPandas(
+            self._left._df._plan, self._right._df._plan,
+            self._left._key_names(), self._right._key_names(),
+            fn, tuple(schema))
+        return DataFrame(self._left._df._session, plan)
+
+    applyInPandas = apply_in_pandas
 
 
 class DataFrame:
@@ -194,6 +254,14 @@ class DataFrame:
         return self._project(projections)
 
     withColumn = with_column
+
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """fn(iterator of pandas DataFrames) -> iterator of DataFrames
+        (Spark mapInPandas; GpuMapInPandasExec analog)."""
+        plan = L.LogicalMapInPandas(self._plan, fn, tuple(schema))
+        return DataFrame(self._session, plan)
+
+    mapInPandas = map_in_pandas
 
     def group_by(self, *keys: Union[str, Column]) -> GroupedData:
         return GroupedData(self, keys)
@@ -344,9 +412,14 @@ class DataFrame:
                         concat_batches(
                             batches, bucket_capacity(
                                 sum(b.capacity for b in batches)))
+                    from spark_rapids_tpu.columnar.batch import _JIT_CACHE
                     from spark_rapids_tpu.columnar.rowmove import \
                         compact_batch
-                    single = _jax.jit(compact_batch)(single)
+                    fn = _JIT_CACHE.get("to_jax_compact")
+                    if fn is None:
+                        fn = _jax.jit(compact_batch)
+                        _JIT_CACHE["to_jax_compact"] = fn
+                    single = fn(single)
                     n = int(single.live_count())
                 finally:
                     set_active_catalog(None)

@@ -267,6 +267,11 @@ HBM_POOL_FRACTION = conf("spark.rapids.memory.tpu.allocFraction").doc(
     "watermark spills-and-retries at the dispatch site (memory/oom.py), "
     "so the budget can run close to full.").double(0.9)
 
+CONCURRENT_PYTHON_WORKERS = conf(
+    "spark.rapids.python.concurrentPythonWorkers").doc(
+    "Max pandas-UDF group functions evaluated concurrently "
+    "(PythonWorkerSemaphore analog; 0 or 1 = serial).").integer(4)
+
 MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
     "Log every catalog buffer add/acquire/spill/remove with sizes, record "
     "creation stacks, and emit a leak report (unfreed buffers + where "

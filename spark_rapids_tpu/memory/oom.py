@@ -35,8 +35,10 @@ def get_active_catalog():
 
 def is_oom_error(e: BaseException) -> bool:
     s = f"{type(e).__name__}: {e}"
+    # Deliberately narrow: a spurious match triggers a full
+    # spill-everything pass plus a duplicate dispatch of the failing op.
     return ("RESOURCE_EXHAUSTED" in s or "Out of memory" in s
-            or "out of memory" in s or "OOM" in s)
+            or "out of memory" in s)
 
 
 def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:

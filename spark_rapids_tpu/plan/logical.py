@@ -1019,6 +1019,68 @@ class LogicalUnion(LogicalPlan):
         return self.children[0].schema
 
 
+class LogicalMapInPandas(_Unary):
+    """mapInPandas (GpuMapInPandasExec analog)."""
+
+    def __init__(self, child, fn, out_schema: Schema):
+        super().__init__(child)
+        self.fn = fn
+        self.out_schema = tuple(out_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+
+class LogicalGroupedMapInPandas(_Unary):
+    """groupBy().applyInPandas (GpuFlatMapGroupsInPandasExec analog)."""
+
+    def __init__(self, child, key_names: Sequence[str], fn,
+                 out_schema: Schema):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.fn = fn
+        self.out_schema = tuple(out_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+
+class LogicalCoGroupedMapInPandas(LogicalPlan):
+    """cogroup().applyInPandas (GpuCoGroupedMapInPandasExec analog)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[str], right_keys: Sequence[str],
+                 fn, out_schema: Schema):
+        self.children = (left, right)
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.fn = fn
+        self.out_schema = tuple(out_schema)
+
+    @property
+    def schema(self) -> Schema:
+        return self.out_schema
+
+
+class LogicalAggInPandas(_Unary):
+    """groupBy().agg of GROUPED_AGG pandas UDFs
+    (GpuAggregateInPandasExec analog). ``aggs`` entries are
+    (out_name, input_column_name, series_fn, result_type)."""
+
+    def __init__(self, child, key_names: Sequence[str], aggs):
+        super().__init__(child)
+        self.key_names = list(key_names)
+        self.aggs = list(aggs)
+
+    @property
+    def schema(self) -> Schema:
+        key_types = dict(self.child.schema)
+        return tuple([(k, key_types[k]) for k in self.key_names]
+                     + [(n, t) for n, _, _, t in self.aggs])
+
+
 class LogicalJoin(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[Column], right_keys: Sequence[Column],

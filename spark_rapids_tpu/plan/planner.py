@@ -582,7 +582,62 @@ class Planner:
                 child, elements, position=plan.position, outer=plan.outer,
                 element_name=plan.out_name,
                 skip_nulls=plan.outer), want_dev
+        if isinstance(plan, L.LogicalMapInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import MapInPandasExec
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            return MapInPandasExec(child, plan.fn,
+                                   plan.out_schema), want_dev
+        if isinstance(plan, L.LogicalGroupedMapInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import \
+                FlatMapGroupsInPandasExec
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            child = self._pandas_group_exchange(child, plan.child.schema,
+                                                plan.key_names, want_dev)
+            return FlatMapGroupsInPandasExec(
+                child, plan.key_names, plan.fn, plan.out_schema), want_dev
+        if isinstance(plan, L.LogicalCoGroupedMapInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import \
+                CoGroupedMapInPandasExec
+            lch, ldev = kids[0]
+            rch, rdev = kids[1]
+            lch = self._bridge(lch, ldev, want_dev)
+            rch = self._bridge(rch, rdev, want_dev)
+            lch = self._pandas_group_exchange(
+                lch, plan.children[0].schema, plan.left_keys, want_dev)
+            rch = self._pandas_group_exchange(
+                rch, plan.children[1].schema, plan.right_keys, want_dev)
+            return CoGroupedMapInPandasExec(
+                lch, rch, plan.left_keys, plan.right_keys, plan.fn,
+                plan.out_schema), want_dev
+        if isinstance(plan, L.LogicalAggInPandas):
+            from spark_rapids_tpu.ops.pandas_exec import \
+                AggregateInPandasExec
+            child, cdev = kids[0]
+            child = self._bridge(child, cdev, want_dev)
+            child = self._pandas_group_exchange(child, plan.child.schema,
+                                                plan.key_names, want_dev)
+            return AggregateInPandasExec(child, plan.key_names,
+                                         plan.aggs), want_dev
         raise NotImplementedError(f"cannot convert {plan.name}")
+
+    def _pandas_group_exchange(self, child: Exec, schema, key_names,
+                               want_dev: bool) -> Exec:
+        """Co-partition a pandas-UDF child by its grouping keys so each
+        partition holds whole groups (requiredChildDistribution of the
+        grouped python execs). Host-engine children skip the exchange —
+        the oracle runs single-partition."""
+        if not want_dev:
+            return child
+        names = [n for n, _ in schema]
+        keys = []
+        for k in key_names:
+            if k not in names:
+                raise L.ResolutionError(f"unknown grouping key {k!r}")
+            i = names.index(k)
+            keys.append(BoundReference(i, schema[i][1]))
+        return self._hash_exchange(child, keys, self._shuffle_partitions())
 
     def _convert_window(self, plan: "L.LogicalWindow", kid,
                         want_dev: bool) -> Tuple[Exec, bool]:
