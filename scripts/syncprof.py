@@ -30,12 +30,15 @@ _ENABLED = [False]
 
 
 def _site() -> str:
-    """Innermost spark_rapids_tpu frame of the current stack."""
+    """Innermost TWO spark_rapids_tpu frames (helper + its caller)."""
+    frames = []
     for f in reversed(traceback.extract_stack()):
         if "spark_rapids_tpu" in f.filename and "syncprof" not in f.filename:
             short = f.filename.split("spark_rapids_tpu/")[-1]
-            return f"{short}:{f.lineno} {f.name}"
-    return "<outside engine>"
+            frames.append(f"{short}:{f.lineno} {f.name}")
+            if len(frames) == 2:
+                break
+    return " <- ".join(frames) if frames else "<outside engine>"
 
 
 def _wrap(fn, label):

@@ -288,6 +288,12 @@ def jit_concat_batches(batches: Sequence[DeviceBatch],
     return retry_on_oom(fn, list(batches))
 
 
+# Below this device size a shrink/compaction cannot repay its sizes-pull
+# round trip on the tunneled link (shared by coalescing, broadcasts and
+# downloads).
+MIN_SHRINK_BYTES = 4 << 20
+
+
 def coalesce_iter(batches, target_rows: int, shrink: bool = False,
                   target_bytes: int = 512 * 1024 * 1024):
     """Group a batch stream into ~``target_rows``-capacity batches with
@@ -316,7 +322,9 @@ def coalesce_iter(batches, target_rows: int, shrink: bool = False,
     def flush():
         g = group
         if shrink:
-            g, _ = shrink_all(g)
+            # Only batches worth compacting pay a sizes pull (below the
+            # threshold the kernel-time saved can't repay a round trip).
+            g, _ = shrink_all(g, min_bytes=MIN_SHRINK_BYTES)
         if len(g) == 1:
             return g[0]
         cap = bucket_capacity(sum(b.capacity for b in g))
@@ -372,18 +380,18 @@ def shrink_all(batches: Sequence[DeviceBatch],
     every unknown live count in ONE batched ``jax.device_get`` (each sync
     is a full network round trip on a tunneled device), then re-bucket
     each batch to its live capacity. ``min_bytes`` skips the pull for
-    small dense batches where the saved transfer can't repay the sync
-    (selection-vector batches always materialize). Returns (shrunk
-    batches, live counts — None where the pull was skipped). The one
-    shared implementation of this idiom for aggregates, exchanges,
-    broadcasts and downloads."""
+    batches too small for the saved transfer/compute to repay the sync —
+    including selection-vector batches (every consumer handles sel);
+    callers that NEED exact counts (the exchange's bucket accounting)
+    keep the default 0. Returns (shrunk batches, live counts — None
+    where the pull was skipped). The one shared implementation of this
+    idiom for aggregates, exchanges, broadcasts and downloads."""
     import jax
     batches = list(batches)
     counts: List[Optional[int]] = [b.rows_hint for b in batches]
     unknown = [i for i, b in enumerate(batches)
                if counts[i] is None
-               and (b.sel is not None
-                    or b.device_size_bytes() > min_bytes)]
+               and b.device_size_bytes() > min_bytes]
     if unknown:
         pulled = jax.device_get([batches[i].live_count() for i in unknown])
         for i, c in zip(unknown, pulled):

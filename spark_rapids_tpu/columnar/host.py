@@ -221,10 +221,9 @@ def host_to_device(batch: HostBatch, capacity: Optional[int] = None,
     return wire.upload(batch, capacity, string_widths)
 
 
-# Batches whose device footprint exceeds this get shrunk to their live-row
-# bucket before download: the row-count sync (~1 round trip) buys back the
-# padding bytes, which dominate on a slow link.
-_SHRINK_DOWNLOAD_BYTES = 1 << 22
+# Batches whose device footprint exceeds MIN_SHRINK_BYTES get shrunk to
+# their live-row bucket before download: the row-count sync (~1 round
+# trip) buys back the padding bytes, which dominate on a slow link.
 
 
 def download_batches(batches: Sequence[DeviceBatch],
@@ -242,33 +241,44 @@ def download_batches(batches: Sequence[DeviceBatch],
     """
     import jax
     from spark_rapids_tpu.columnar.batch import shrink_all
-    # Selection-vector batches MUST materialize before download (their live
-    # rows are scattered); padded dense batches shrink only when the saved
-    # bytes beat the row-count sync. One shared batched pull (shrink_all).
-    batches, _ = shrink_all(batches, min_bytes=_SHRINK_DOWNLOAD_BYTES)
+    # LARGE batches shrink first (the row-count sync buys back padding
+    # bytes on the link); small ones — selection vectors included — ship
+    # as-is with their row mask and filter on the HOST, which costs no
+    # device round trip and no compaction gather at all.
+    from spark_rapids_tpu.columnar.batch import MIN_SHRINK_BYTES
+    batches, _ = shrink_all(batches, min_bytes=MIN_SHRINK_BYTES)
     leaves: List = []
     for b in batches:
         leaves.append(b.num_rows)
+        leaves.append(b.sel if b.sel is not None else None)
         for c in b.columns:
             leaves.append(c.data)
             leaves.append(c.validity)
             if c.dtype.is_string:
                 leaves.append(c.lengths)
-    fetched = jax.device_get(leaves)
+    fetched = jax.device_get([x for x in leaves if x is not None])
     it = iter(fetched)
     out = []
     for b in batches:
         n = int(next(it))
+        keep = None
+        if b.sel is not None:
+            keep = np.asarray(next(it))[:n]
         cols = []
         for c in b.columns:
-            data_h = next(it)
+            data_h = np.asarray(next(it))[:n]
             validity = np.asarray(next(it))[:n]
+            lengths = None
             if c.dtype.is_string:
                 lengths = np.asarray(next(it))[:n]
-                cols.append(matrix_to_strings(np.asarray(data_h)[:n],
-                                              lengths, validity))
+            if keep is not None:
+                data_h, validity = data_h[keep], validity[keep]
+                if lengths is not None:
+                    lengths = lengths[keep]
+            if c.dtype.is_string:
+                cols.append(matrix_to_strings(data_h, lengths, validity))
             else:
-                data = np.asarray(data_h)[:n].copy()
+                data = data_h.copy()
                 data[~validity] = np.zeros(1, c.dtype.np_dtype)
                 cols.append(HostColumn(c.dtype, data, validity))
         if names is None:

@@ -129,19 +129,86 @@ def enumerate_units(fmt: str, paths: Sequence[str]) -> List[ScanUnit]:
     return units
 
 
+# ORC stripe stats index (OrcFilters.scala:206 pushdown analog): pyarrow
+# exposes no ORC column statistics, so the engine builds its own per-
+# stripe min/max/null index on FIRST contact with a stripe (one decode of
+# the predicate columns) and prunes every later scan from the cache.
+# (stripe_key) -> {col: (min, max, null_count, rows)}
+_ORC_STATS_CACHE: Dict[Tuple, Dict[str, tuple]] = {}
+_ORC_STATS_CACHE_MAX = 4096
+
+
+class _Stat:
+    """Duck-typed stand-in for a parquet ColumnChunk statistics object."""
+
+    def __init__(self, mn, mx, null_count):
+        self.min, self.max = mn, mx
+        self.null_count = null_count
+        self.has_min_max = mn is not None
+
+
+def _orc_stripe_stats(unit: ScanUnit, names: Sequence[str]
+                      ) -> Tuple[Dict[str, "_Stat"], int]:
+    """(per-column stats, stripe row count). Columns missing from the
+    file cache a no-stats sentinel so they are never re-probed."""
+    st = os.stat(unit.path)
+    key = (unit.path, st.st_mtime, st.st_size, unit.index)
+    cached = _ORC_STATS_CACHE.get(key)
+    need = [n for n in names
+            if cached is None or n not in cached]
+    if need:
+        f = paorc.ORCFile(unit.path)
+        have = set(f.schema.names)
+        cols = [n for n in need if n in have]
+        entry = dict(cached or {})
+        if cols:
+            tab = f.read_stripe(unit.index, columns=cols)
+            for n in cols:
+                c = tab.column(n)
+                nulls = c.null_count
+                if nulls == len(c):
+                    entry[n] = (None, None, nulls, len(c))
+                else:
+                    import pyarrow.compute as pc
+                    mm = pc.min_max(c).as_py()
+                    entry[n] = (mm["min"], mm["max"], nulls, len(c))
+        for n in need:
+            if n not in entry:      # absent column: unknown-stats marker
+                entry[n] = (None, None, None, -1)
+        while len(_ORC_STATS_CACHE) >= _ORC_STATS_CACHE_MAX:
+            _ORC_STATS_CACHE.pop(next(iter(_ORC_STATS_CACHE)))
+        _ORC_STATS_CACHE[key] = entry
+        cached = entry
+    num_rows = max((rows for (_, _, _, rows) in cached.values()
+                    if rows >= 0), default=0)
+    return ({n: _Stat(mn, mx, nulls)
+             for n, (mn, mx, nulls, rows) in cached.items()
+             if rows >= 0}, num_rows)
+
+
 def _unit_survives(fmt: str, unit: ScanUnit,
                    predicates: Sequence[Tuple[str, str, Any]]) -> bool:
-    """False when row-group statistics prove no row in the unit can
-    satisfy ALL pushed conjuncts (conservative: missing/odd stats keep
-    the unit). SQL null semantics make this safe — a comparison is never
-    true for NULL, so bounds over non-null values suffice."""
-    if fmt != "parquet" or not predicates:
+    """False when unit statistics prove no row can satisfy ALL pushed
+    conjuncts (conservative: missing/odd stats keep the unit). SQL null
+    semantics make this safe — a comparison is never true for NULL, so
+    bounds over non-null values suffice. Parquet reads footer stats; ORC
+    uses the engine's own first-contact stripe index."""
+    if not predicates or fmt == "csv":
         return True
+    if fmt == "orc":
+        stats_by_name, num_rows = _orc_stripe_stats(
+            unit, [name for name, _, _ in predicates])
+        return _stats_survive(stats_by_name, num_rows, predicates)
     rg = _parquet_metadata(unit.path).row_group(unit.index)
     stats_by_name = {}
     for ci in range(rg.num_columns):
         col = rg.column(ci)
         stats_by_name[col.path_in_schema] = col.statistics
+    return _stats_survive(stats_by_name, rg.num_rows, predicates)
+
+
+def _stats_survive(stats_by_name, num_rows,
+                   predicates: Sequence[Tuple[str, str, Any]]) -> bool:
     for name, op, value in predicates:
         st = stats_by_name.get(name)
         if st is None:
@@ -149,14 +216,14 @@ def _unit_survives(fmt: str, unit: ScanUnit,
         try:
             if op == "isnotnull":
                 if st.null_count is not None and \
-                        st.null_count == rg.num_rows:
+                        st.null_count == num_rows:
                     return False
                 continue
             if not st.has_min_max:
                 # All-null pages carry no min/max: a comparison predicate
                 # can never be true then.
                 if st.null_count is not None and \
-                        st.null_count == rg.num_rows:
+                        st.null_count == num_rows:
                     return False
                 continue
             mn, mx = st.min, st.max

@@ -4,15 +4,27 @@ GpuFileFormatWriter.scala's per-partition files).
 
 Each engine partition writes one ``part-NNNNN`` file inside the output
 directory (Spark's directory-of-parts layout), chunked through arrow
-writers (Table.writeParquetChunked analog).
+writers (Table.writeParquetChunked analog). ``partition_by`` switches to
+dynamic partitioning: rows split by their partition-column values into
+``col=value/`` subdirectories, partition columns dropped from the file
+contents (GpuFileFormatWriter.scala:338's dynamic write — the reference
+sorts by partition columns to bound open writers; this host-side writer
+groups each batch instead, holding one open writer per seen partition).
+
+Every write records stats (BasicColumnarWriteStatsTracker.scala:180
+analog) in ``last_stats``: numFiles, numOutputRows, numOutputBytes,
+numParts (dynamic partition directories).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
-from spark_rapids_tpu.columnar.host import HostBatch, device_to_host
+import numpy as np
+
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn, \
+    device_to_host
 from spark_rapids_tpu.io.arrow_convert import host_batch_to_arrow
 
 import pyarrow as pa
@@ -21,11 +33,65 @@ import pyarrow.orc as paorc
 import pyarrow.parquet as papq
 
 
+# Characters Hive escapes in partition paths (ExternalCatalogUtils
+# escapePathName): anything that could change the directory structure.
+_ESCAPE = set('"#%\'*/:=?\\\x7f{[]^') | {chr(c) for c in range(0x20)}
+
+
+def _part_value(v) -> str:
+    """Hive-style partition directory value, path-safely escaped."""
+    if v is None:
+        return "__HIVE_DEFAULT_PARTITION__"
+    if isinstance(v, bytes):
+        v = v.decode("utf-8", errors="replace")
+    elif isinstance(v, float):
+        import math
+        if math.isfinite(v) and v == int(v):
+            v = int(v)
+    s = str(v)
+    return "".join(f"%{ord(ch):02X}" if ch in _ESCAPE else ch
+                   for ch in s)
+
+
+def _take_rows(hb: HostBatch, idx: np.ndarray,
+               keep_cols: List[int]) -> HostBatch:
+    cols = []
+    names = []
+    for ci in keep_cols:
+        c = hb.columns[ci]
+        if c.dtype.is_string and c.str_matrix is not None:
+            # Slice the dense byte matrix; never materialize the lazy
+            # per-row object array.
+            cols.append(HostColumn(c.dtype, None, c.validity[idx],
+                                   str_matrix=c.str_matrix[idx],
+                                   str_lengths=c.str_lengths[idx]))
+        else:
+            cols.append(HostColumn(c.dtype, c.data[idx],
+                                   c.validity[idx]))
+        names.append(hb.names[ci])
+    return HostBatch(tuple(names), cols)
+
+
+class _Stats:
+    def __init__(self):
+        self.values = {"numFiles": 0, "numOutputRows": 0,
+                       "numOutputBytes": 0, "numParts": 0}
+
+    def file_closed(self, path: str):
+        self.values["numFiles"] += 1
+        try:
+            self.values["numOutputBytes"] += os.path.getsize(path)
+        except OSError:
+            pass
+
+
 class DataFrameWriter:
     def __init__(self, df):
         self._df = df
         self._options: Dict = {}
         self._mode = "error"
+        self._partition_by: List[str] = []
+        self.last_stats: Optional[Dict] = None
 
     def option(self, key: str, value) -> "DataFrameWriter":
         self._options[key] = value
@@ -34,6 +100,12 @@ class DataFrameWriter:
     def mode(self, m: str) -> "DataFrameWriter":
         self._mode = m
         return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
 
     def _prepare_dir(self, path: str):
         if os.path.exists(path):
@@ -44,6 +116,20 @@ class DataFrameWriter:
                 raise FileExistsError(path)
         os.makedirs(path, exist_ok=True)
 
+    def _open(self, fmt: str, out: str, table):
+        if fmt == "parquet":
+            return papq.ParquetWriter(out, table.schema)
+        if fmt == "orc":
+            return paorc.ORCWriter(out)
+        return pacsv.CSVWriter(out, table.schema)
+
+    @staticmethod
+    def _append(fmt: str, writer, table):
+        if fmt == "parquet":
+            writer.write_table(table)
+        else:
+            writer.write(table)
+
     def _write(self, path: str, fmt: str):
         import uuid
         from spark_rapids_tpu.ops.base import ExecContext
@@ -53,50 +139,99 @@ class DataFrameWriter:
         ctx.cache["engine"] = "device" if phys.root_on_device else "host"
         root = phys.root
         names = tuple(n for n, _ in root.schema)
+        stats = _Stats()
         n_parts = root.num_partitions(ctx)
         # Unique job id in file names so append mode never clobbers a
         # previous write's parts (Spark's write-uuid naming).
         job = uuid.uuid4().hex[:8]
+        part_ords = []
+        for k in self._partition_by:
+            if k not in names:
+                raise ValueError(f"unknown partitionBy column {k!r}")
+            part_ords.append(names.index(k))
+        data_ords = [i for i in range(len(names)) if i not in part_ords]
+        part_dirs = set()
         for p in range(n_parts):
             out = os.path.join(path, f"part-{p:05d}-{job}.{fmt}")
-            writer = None
+            writers: Dict = {}      # key -> (writer, path); None key=plain
             wrote = False
             for b in (root.execute_device(ctx, p) if phys.root_on_device
                       else root.execute_host(ctx, p)):
                 hb = device_to_host(b, names) if phys.root_on_device else b
                 if hb.num_rows == 0 and wrote:
                     continue
-                table = host_batch_to_arrow(hb)
-                if fmt == "parquet":
-                    if writer is None:
-                        writer = papq.ParquetWriter(out, table.schema)
-                    writer.write_table(table)
-                elif fmt == "orc":
-                    if writer is None:
-                        writer = paorc.ORCWriter(out)
-                    writer.write(table)
-                elif fmt == "csv":
-                    if writer is None:
-                        writer = pacsv.CSVWriter(out, table.schema)
-                    writer.write(table)
+                if not self._partition_by:
+                    table = host_batch_to_arrow(hb)
+                    if None not in writers:
+                        writers[None] = (self._open(fmt, out, table), out)
+                    self._append(fmt, writers[None][0], table)
+                    stats.values["numOutputRows"] += hb.num_rows
+                    wrote = True
+                    continue
+                # Dynamic partitioning: group this batch's rows by their
+                # partition-column value tuple (vectorized factorize per
+                # key column), one open writer per seen directory.
+                import pandas as pd
+                code_cols = []
+                uniq_cols = []
+                for o in part_ords:
+                    c = hb.columns[o]
+                    vals = np.where(c.validity, c.data, None)
+                    codes, uniques = pd.factorize(vals, sort=False)
+                    code_cols.append(codes)          # -1 = None
+                    uniq_cols.append(list(uniques))
+                gid = np.zeros(hb.num_rows, np.int64)
+                for codes, uniques in zip(code_cols, uniq_cols):
+                    gid = gid * (len(uniques) + 1) + (codes + 1)
+                order = np.argsort(gid, kind="stable")
+                bounds = np.flatnonzero(np.diff(gid[order])) + 1
+                groups = np.split(order, bounds)
+                def key_of(row_i):
+                    return tuple(
+                        None if codes[row_i] < 0 else uniques[codes[row_i]]
+                        for codes, uniques in zip(code_cols, uniq_cols))
+                keyed = sorted(
+                    ((key_of(int(rows[0])), rows) for rows in groups
+                     if len(rows)),
+                    key=lambda kv: tuple(map(_part_value, kv[0])))
+                for k, rows in keyed:
+                    sub = _take_rows(hb, np.asarray(rows, np.int64),
+                                     data_ords)
+                    table = host_batch_to_arrow(sub)
+                    if k not in writers:
+                        sub_dir = os.path.join(path, *[
+                            f"{name}={_part_value(v)}"
+                            for name, v in zip(self._partition_by, k)])
+                        os.makedirs(sub_dir, exist_ok=True)
+                        part_dirs.add(sub_dir)
+                        f = os.path.join(sub_dir,
+                                         f"part-{p:05d}-{job}.{fmt}")
+                        writers[k] = (self._open(fmt, f, table), f)
+                    self._append(fmt, writers[k][0], table)
+                    stats.values["numOutputRows"] += sub.num_rows
                 wrote = True
-            if writer is not None:
-                writer.close()
-            elif not wrote:
+            for w, fpath in writers.values():
+                w.close()
+                stats.file_closed(fpath)
+            if not writers and not wrote and not self._partition_by:
                 # Empty partition still writes schema-only file (parquet).
                 if fmt == "parquet":
                     empty = host_batch_to_arrow(
                         _empty_host_batch(root.schema))
                     papq.write_table(empty, out)
+                    stats.file_closed(out)
+        stats.values["numParts"] = len(part_dirs)
+        self.last_stats = dict(stats.values)
+        return self.last_stats
 
     def parquet(self, path: str):
-        self._write(path, "parquet")
+        return self._write(path, "parquet")
 
     def orc(self, path: str):
-        self._write(path, "orc")
+        return self._write(path, "orc")
 
     def csv(self, path: str):
-        self._write(path, "csv")
+        return self._write(path, "csv")
 
 
 def _empty_host_batch(schema) -> HostBatch:

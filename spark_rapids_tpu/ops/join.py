@@ -457,9 +457,14 @@ class _JoinKernelMixin:
             if jt == "full" else None
         # Coalesce the probe stream: per-batch probe work has a fixed
         # device-latency floor, so 8 scan-file batches cost 8 floors where
-        # 1-2 coalesced batches cost 1-2 (zero extra syncs — static caps).
+        # 1-2 coalesced batches cost 1-2. shrink=True compacts sparse
+        # members first (an upstream selective join's output would
+        # otherwise make EVERY downstream probe gather pay its full
+        # capacity); the sizes pull is batched per group and skipped
+        # where rows_hint is known (scans).
         probe_iter = coalesce_iter(
             probe_iter, int(ctx.conf.get(C.BATCH_SIZE_ROWS)),
+            shrink=True,
             target_bytes=int(ctx.conf.get(C.BATCH_SIZE_BYTES)))
         # Dispatch the FIRST probe batch's upstream work before blocking on
         # the build stats: the async stats copy then overlaps probe-side

@@ -426,10 +426,15 @@ class BroadcastExchangeExec(Exec):
         if not batches:
             raise ValueError("broadcast of empty child needs a schema batch")
         # One batched sizes pull, then shrink members to live scale: the
-        # broadcast build side's capacity bounds every probe-side gather
-        # downstream, so padding here multiplies into the join.
-        from spark_rapids_tpu.columnar.batch import shrink_all
-        batches, _ = shrink_all(batches)
+        # broadcast build side's capacity bounds the build-side sort and
+        # (on the slow path) probe expansion. SMALL batches skip the pull
+        # entirely — a dimension table's shrink can't repay a ~100ms
+        # round trip, and the join kernels handle selection vectors.
+        from spark_rapids_tpu.columnar.batch import (MIN_SHRINK_BYTES,
+                                                      shrink_all)
+        if any(b.device_size_bytes() >= MIN_SHRINK_BYTES
+               for b in batches):
+            batches, _ = shrink_all(batches)
         total = sum(b.capacity for b in batches)
         single = batches[0] if len(batches) == 1 else \
             concat_batches(batches, bucket_capacity(total))
