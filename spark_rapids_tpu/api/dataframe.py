@@ -345,15 +345,19 @@ class DataFrame:
     def collect(self) -> List[tuple]:
         return self._physical().collect()
 
-    def collect_host(self) -> List[tuple]:
-        """Run entirely on the host oracle engine (CPU-Spark stand-in):
-        re-plans with sql.enabled off so no device bridges appear."""
+    def _host_physical(self):
+        """Re-plan with sql.enabled off (the host fallback engine — no
+        device bridges). Shared by collect_host and gated writes."""
         import spark_rapids_tpu.config as C
         host_conf = C.TpuConf(dict(self._session.conf.raw))
         host_conf.set("spark.rapids.sql.enabled", False)
-        phys = Planner(host_conf).plan(self._plan)
+        return Planner(host_conf).plan(self._plan)
+
+    def collect_host(self) -> List[tuple]:
+        """Run entirely on the host oracle engine (CPU-Spark stand-in)."""
+        phys = self._host_physical()
         from spark_rapids_tpu.ops.base import ExecContext
-        return phys.root.collect(ExecContext(host_conf), device=False)
+        return phys.root.collect(ExecContext(phys.conf), device=False)
 
     def count_rows(self) -> int:
         return len(self.collect())
@@ -453,14 +457,26 @@ class DataFrame:
                 out[name] = jnp.zeros((0,), t.np_dtype)
         return out
 
+    _METRIC_LEVELS = {
+        "ESSENTIAL": {"numOutputRows", "totalTime"},
+        "MODERATE": {"numOutputRows", "totalTime", "numOutputBatches",
+                     "shuffleTime", "bufferTime"},
+    }
+
     def metrics(self):
         """Per-operator metrics of the LAST collect() on this DataFrame
-        (GpuExec.scala:27-56 registry; empty before any action)."""
+        (GpuExec.scala:27-56 registry; empty before any action).
+        ``spark.rapids.sql.metrics.level`` filters verbosity."""
+        import spark_rapids_tpu.config as C
         phys = self._physical()
         ctx = getattr(phys, "last_ctx", None)
         if ctx is None:
             return {}
-        return {k: dict(m.values) for k, m in ctx.metrics.items()}
+        level = str(self._session.conf.get(C.METRICS_LEVEL)).upper()
+        keep = self._METRIC_LEVELS.get(level)
+        return {k: {name: v for name, v in m.values.items()
+                    if keep is None or name in keep}
+                for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------
     @property

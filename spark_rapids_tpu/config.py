@@ -229,6 +229,34 @@ ENABLE_CSV = conf("spark.rapids.sql.format.csv.enabled").doc(
 ENABLE_ORC = conf("spark.rapids.sql.format.orc.enabled").doc(
     "Enable ORC scan/write on TPU path.").boolean(True)
 
+ENABLE_PARQUET_READ = conf(
+    "spark.rapids.sql.format.parquet.read.enabled").doc(
+    "Enable parquet reads on the TPU path (scan falls back to the host "
+    "engine when off; finer grain than format.parquet.enabled)."
+).boolean(True)
+
+ENABLE_PARQUET_WRITE = conf(
+    "spark.rapids.sql.format.parquet.write.enabled").doc(
+    "Enable the device plan feeding parquet writes (off = the write job "
+    "runs through the host fallback engine).").boolean(True)
+
+ENABLE_ORC_READ = conf("spark.rapids.sql.format.orc.read.enabled").doc(
+    "Enable ORC reads on the TPU path.").boolean(True)
+
+ENABLE_ORC_WRITE = conf("spark.rapids.sql.format.orc.write.enabled").doc(
+    "Enable the device plan feeding ORC writes.").boolean(True)
+
+ENABLE_CSV_READ = conf("spark.rapids.sql.format.csv.read.enabled").doc(
+    "Enable CSV reads on the TPU path.").boolean(True)
+
+ORC_READER_TYPE = conf("spark.rapids.sql.format.orc.reader.type").doc(
+    "ORC reader strategy: PERFILE, COALESCING, MULTITHREADED, or AUTO "
+    "(GpuOrcScan multi-file reader selection analog).").string("AUTO")
+
+CSV_READER_TYPE = conf("spark.rapids.sql.format.csv.reader.type").doc(
+    "CSV reader strategy: PERFILE, COALESCING, MULTITHREADED, or AUTO."
+).string("AUTO")
+
 REPLACE_SORT_MERGE_JOIN = conf(
     "spark.rapids.sql.replaceSortMergeJoin.enabled").doc(
     "Replace sort-merge joins with TPU hash joins, dropping the sorts "
@@ -278,6 +306,22 @@ MEMORY_DEBUG = conf("spark.rapids.memory.tpu.debug").doc(
     "they were allocated) when the query context closes (ref: "
     "spark.rapids.memory.gpu.debug, RapidsConf.scala:288 + cuDF "
     "MemoryCleaner leak callstacks).").boolean(False)
+
+MAX_ALLOC_FRACTION = conf(
+    "spark.rapids.memory.tpu.maxAllocFraction").doc(
+    "Hard ceiling on the fraction of visible HBM the batch-storage "
+    "budget may claim, regardless of allocFraction (RapidsConf's "
+    "maxAllocFraction).").double(0.95)
+
+RESERVE_BYTES = conf("spark.rapids.memory.tpu.reserve").doc(
+    "HBM bytes held back from the batch-storage budget for compute "
+    "transients and the XLA runtime (spark.rapids.memory.gpu.reserve "
+    "analog).").long(512 * 1024 * 1024)
+
+METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
+    "Operator metric verbosity reported by DataFrame.metrics(): "
+    "ESSENTIAL (rows/time), MODERATE (+batches/shuffle), or DEBUG "
+    "(everything the execs record).").string("DEBUG")
 
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
     "Bytes of host RAM for spilled device batches before going to disk."

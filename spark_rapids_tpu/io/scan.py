@@ -368,7 +368,10 @@ class FileScanExec(LeafExec):
     def _reader_type(self, ctx) -> str:
         if self.force_perfile:
             return "PERFILE"
-        rt = str(ctx.conf.get(C.PARQUET_READER_TYPE)).upper()
+        entry = {"parquet": C.PARQUET_READER_TYPE,
+                 "orc": C.ORC_READER_TYPE,
+                 "csv": C.CSV_READER_TYPE}[self.fmt]
+        rt = str(ctx.conf.get(entry)).upper()
         if rt == "AUTO":
             return "MULTITHREADED"
         return rt

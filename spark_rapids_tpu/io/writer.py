@@ -132,10 +132,19 @@ class DataFrameWriter:
 
     def _write(self, path: str, fmt: str):
         import uuid
+        import spark_rapids_tpu.config as C
         from spark_rapids_tpu.ops.base import ExecContext
         self._prepare_dir(path)
-        phys = self._df._physical()
-        ctx = ExecContext(self._df._session.conf)
+        conf = self._df._session.conf
+        write_gate = {"parquet": C.ENABLE_PARQUET_WRITE,
+                      "orc": C.ENABLE_ORC_WRITE}.get(fmt)
+        if write_gate is not None and not bool(conf.get(write_gate)):
+            # Write gate off: the job runs through the host fallback
+            # engine (the reference's CPU FileFormatWriter fallback).
+            phys = self._df._host_physical()
+        else:
+            phys = self._df._physical()
+        ctx = ExecContext(phys.conf)
         ctx.cache["engine"] = "device" if phys.root_on_device else "host"
         root = phys.root
         names = tuple(n for n, _ in root.schema)
@@ -176,8 +185,11 @@ class DataFrameWriter:
                 uniq_cols = []
                 for o in part_ords:
                     c = hb.columns[o]
-                    vals = np.where(c.validity, c.data, None)
-                    codes, uniques = pd.factorize(vals, sort=False)
+                    # Factorize the native array (no per-row boxing);
+                    # nulls become code -1 afterwards.
+                    codes, uniques = pd.factorize(c.data, sort=False)
+                    codes = np.asarray(codes).copy()
+                    codes[~c.validity] = -1
                     code_cols.append(codes)          # -1 = None
                     uniq_cols.append(list(uniques))
                 gid = np.zeros(hb.num_rows, np.int64)

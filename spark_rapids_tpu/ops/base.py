@@ -75,8 +75,15 @@ class ExecContext:
             from spark_rapids_tpu.memory.stores import BufferCatalog
             budget = int(self.conf.get(C.DEVICE_BUDGET_BYTES))
             if budget <= 0:
-                budget = int(_visible_device_bytes()
+                visible = _visible_device_bytes()
+                budget = int(visible
                              * float(self.conf.get(C.HBM_POOL_FRACTION)))
+                # Ceiling + runtime reserve (maxAllocFraction / reserve,
+                # RapidsConf's RMM pool bounds).
+                ceiling = int(visible * float(
+                    self.conf.get(C.MAX_ALLOC_FRACTION))) \
+                    - int(self.conf.get(C.RESERVE_BYTES))
+                budget = max(min(budget, ceiling), 1 << 20)
             self._catalog = BufferCatalog(
                 device_budget_bytes=budget,
                 host_budget_bytes=int(

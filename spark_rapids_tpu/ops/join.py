@@ -806,6 +806,13 @@ class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
                                             built, True)
             return
         build = coalesce_to_single_batch(bbatches)
+        if build.sel is not None:
+            # The NLJ pairs every probe row with build positions
+            # 0..num_rows-1; a selection vector (small filtered build that
+            # skipped the broadcast shrink) must compact first or deleted
+            # rows would join as live.
+            from spark_rapids_tpu.columnar.rowmove import compact_batch
+            build = jax.jit(compact_batch)(build)
         built = BuiltSide(build, None, build.row_mask(),
                           build.row_mask(), build.num_rows)
         bcap = build.capacity

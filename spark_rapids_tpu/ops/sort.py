@@ -96,18 +96,68 @@ class _SpillableListSource(Exec):
         raise AssertionError("device-only staging source")
 
 
+def out_of_core_partition(ctx, metrics, child_iter, schema,
+                          split_orders: Sequence[SortOrder], batch_fn):
+    """Shared out-of-core scaffold (SortExec's sample-sort shape, also
+    used by partition-chunked windows): stage the partition's batches as
+    catalog spillables; small partitions run ``batch_fn`` over one
+    coalesced batch, larger ones range-split by ``split_orders`` through
+    the exchange into bounded spillable buckets and run ``batch_fn`` per
+    bucket (equal keys always share a bucket). Yields output batches."""
+    from spark_rapids_tpu.memory.oom import retry_on_oom
+    from spark_rapids_tpu.memory.stores import (
+        PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+    m = metrics
+    spillables = []
+    total_bytes = 0
+    for b in child_iter:
+        total_bytes += b.device_size_bytes()
+        spillables.append(SpillableBatch(ctx.catalog, b,
+                                         PRIORITY_SHUFFLE_OUTPUT))
+    if not spillables:
+        return
+    bucket_budget = max(ctx.catalog.device_budget // 3, 1 << 16)
+    if total_bytes <= bucket_budget or not split_orders:
+        batches = [sb.get() for sb in spillables]
+        single = coalesce_to_single_batch(batches)
+        for sb in spillables:
+            sb.close()
+        with timed(m):
+            out = retry_on_oom(batch_fn, single)
+        m.add("numOutputBatches", 1)
+        yield out
+        return
+    from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+    from spark_rapids_tpu.parallel.partitioning import RangePartitioning
+    nb = max(2, -(-total_bytes // bucket_budget))
+    m.add("outOfCoreBuckets", nb)
+    src = _SpillableListSource(schema, spillables)
+    ex = ShuffleExchangeExec(src, RangePartitioning(list(split_orders), nb))
+    try:
+        for p in range(nb):
+            bucket = list(ex.execute_device(ctx, p))
+            if not bucket:
+                continue
+            with timed(m):
+                out = retry_on_oom(batch_fn,
+                                   coalesce_to_single_batch(bucket))
+            m.add("numOutputBatches", 1)
+            yield out
+    finally:
+        for sb in spillables:
+            sb.close()
+
+
 class SortExec(Exec):
     """Per-partition full sort (global order requires a range exchange
     upstream, as in Spark).
 
     OUT-OF-CORE (beyond the reference's v0.3 RequireSingleBatch,
-    GpuSortExec.scala:50 — SURVEY §5.7's "thing to beat"): input batches
-    buffer as catalog-registered spillables; when the partition exceeds a
-    fraction of the device budget, the sort becomes a device sample-sort —
-    range-split the input through the exchange machinery into B spillable
-    buckets of bounded size, then sort each bucket independently and
-    stream them in range order. Peak HBM is one bucket + one in-flight
-    batch; the rest rides the host/disk spill tiers."""
+    GpuSortExec.scala:50 — SURVEY §5.7's "thing to beat"): when the
+    partition exceeds a fraction of the device budget the sort becomes a
+    device sample-sort via :func:`out_of_core_partition` — bounded
+    buckets sort independently and stream in range order. Peak HBM is
+    one bucket + one in-flight batch; the rest rides the spill tiers."""
 
     def __init__(self, child: Exec, orders: Sequence[SortOrder]):
         super().__init__(child)
@@ -128,52 +178,10 @@ class SortExec(Exec):
                                                   stable=stable))
 
     def execute_device(self, ctx, partition):
-        from spark_rapids_tpu.memory.stores import (
-            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
-        m = ctx.metrics_for(self)
-        spillables = []
-        total_bytes = 0
-        for b in self.children[0].execute_device(ctx, partition):
-            total_bytes += b.device_size_bytes()
-            spillables.append(SpillableBatch(ctx.catalog, b,
-                                             PRIORITY_SHUFFLE_OUTPUT))
-        if not spillables:
-            return
-        fn = self._sort_fn(ctx)
-        bucket_budget = max(ctx.catalog.device_budget // 3, 1 << 20)
-        from spark_rapids_tpu.memory.oom import retry_on_oom
-        if total_bytes <= bucket_budget:
-            batches = [sb.get() for sb in spillables]
-            single = coalesce_to_single_batch(batches)
-            for sb in spillables:
-                sb.close()
-            with timed(m):
-                out = retry_on_oom(fn, single)
-            m.add("numOutputBatches", 1)
-            yield out
-            return
-        # Sample-sort: range-split into B ~bucket_budget buckets via the
-        # exchange (its sizes-then-split path, spillable pieces, and
-        # range-bounds sampling are exactly what this phase needs).
-        from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
-        from spark_rapids_tpu.parallel.partitioning import RangePartitioning
-        nb = max(2, -(-total_bytes // bucket_budget))
-        m.add("outOfCoreBuckets", nb)
-        src = _SpillableListSource(self.schema, spillables)
-        ex = ShuffleExchangeExec(src, RangePartitioning(self.orders, nb))
-        try:
-            for p in range(nb):
-                bucket = list(ex.execute_device(ctx, p))
-                if not bucket:
-                    continue
-                with timed(m):
-                    out = retry_on_oom(
-                        fn, coalesce_to_single_batch(bucket))
-                m.add("numOutputBatches", 1)
-                yield out
-        finally:
-            for sb in spillables:
-                sb.close()
+        yield from out_of_core_partition(
+            ctx, ctx.metrics_for(self),
+            self.children[0].execute_device(ctx, partition),
+            self.schema, self.orders, self._sort_fn(ctx))
 
     def execute_host(self, ctx, partition):
         hbs = list(self.children[0].execute_host(ctx, partition))

@@ -475,8 +475,15 @@ def _whole_partition(fn: WindowAgg, sdata, svalid, gid, cap):
 # ---------------------------------------------------------------------------
 
 class WindowExec(Exec):
-    """Appends window expression columns (requires single batch per
-    partition, like GpuWindowExec v0.3)."""
+    """Appends window expression columns.
+
+    OUT-OF-CORE (beyond GpuWindowExec v0.3's RequireSingleBatch): when a
+    partitioned window's input exceeds a fraction of the device budget,
+    the input range-splits by the window PARTITION KEYS into bounded
+    spillable buckets — equal keys always land in one bucket, so each
+    bucket's windows compute independently (the partition-chunked shape
+    of SURVEY §5.7). Unpartitioned (whole-table frame) windows cannot
+    chunk and keep the single-batch requirement."""
 
     def __init__(self, child: Exec, exprs: Sequence[WindowExprSpec]):
         super().__init__(child)
@@ -490,18 +497,22 @@ class WindowExec(Exec):
             base.append((wx.name, wx.fn.result_type()))
         return tuple(base)
 
-    def execute_device(self, ctx, partition):
-        m = ctx.metrics_for(self)
-        batches = list(self.children[0].execute_device(ctx, partition))
-        if not batches:
-            return
-        single = coalesce_to_single_batch(batches)
+    def _window_fn(self):
         if self._jit is None:
             self._jit = jax.jit(lambda b: compute_window(b, self.exprs))
-        with timed(m):
-            out = self._jit(single)
-        m.add("numOutputBatches", 1)
-        yield out
+        return self._jit
+
+    def execute_device(self, ctx, partition):
+        from spark_rapids_tpu.ops.sort import out_of_core_partition
+        # Chunking splits on the window PARTITION KEYS (equal keys share
+        # a bucket); unpartitioned windows pass no orders and stay
+        # single-batch.
+        pcols = self.exprs[0].spec.partition_by if self.exprs else []
+        orders = [SortOrder(c) for c in pcols]
+        yield from out_of_core_partition(
+            ctx, ctx.metrics_for(self),
+            self.children[0].execute_device(ctx, partition),
+            self.children[0].schema, orders, self._window_fn())
 
     # -- host oracle ---------------------------------------------------------
     def execute_host(self, ctx, partition):

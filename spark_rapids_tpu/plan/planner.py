@@ -247,7 +247,16 @@ def wrap_and_tag(plan: LogicalPlan, conf: C.TpuConf) -> NodeMeta:
     if not conf.is_op_enabled(_exec_conf_key(plan.name)):
         reasons.append(f"disabled by {_exec_conf_key(plan.name)}")
 
-    if isinstance(plan, L.LogicalFilter):
+    if isinstance(plan, L.FileScan):
+        fmt_gates = {
+            "parquet": (C.ENABLE_PARQUET, C.ENABLE_PARQUET_READ),
+            "orc": (C.ENABLE_ORC, C.ENABLE_ORC_READ),
+            "csv": (C.ENABLE_CSV, C.ENABLE_CSV_READ),
+        }
+        for entry in fmt_gates.get(plan.fmt, ()):
+            if not bool(conf.get(entry)):
+                reasons.append(f"{plan.fmt} scan disabled by {entry.key}")
+    elif isinstance(plan, L.LogicalFilter):
         tag_column(plan.condition, conf, reasons, notes,
                    plan.child.schema)
     elif isinstance(plan, L.LogicalProject):

@@ -147,3 +147,86 @@ class TestHasNans:
         got = dict(q.collect())
         import math
         assert math.isnan(got[1]) and got[2] == 5.0
+
+
+class TestFormatAndMemoryGates:
+    """Round-5 config additions: per-format read/write gates, per-format
+    reader strategies, memory ceiling/reserve, metrics level."""
+
+    def test_parquet_read_gate_falls_back(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as papq
+        import numpy as np
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        p = str(tmp_path / "t.parquet")
+        papq.write_table(pa.table({"x": np.arange(10,
+                                                  dtype=np.int64)}), p)
+        s = TpuSession()
+        s.set("spark.rapids.sql.format.parquet.read.enabled", False)
+        df = s.read.parquet(p)
+        report = df._physical().explain()
+        assert "parquet scan disabled" in report
+        assert sorted(r[0] for r in df.collect()) == list(range(10))
+
+    def test_orc_reader_type_key(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.orc as paorc
+        import numpy as np
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        from spark_rapids_tpu.ops.base import ExecContext
+        p = str(tmp_path / "t.orc")
+        paorc.write_table(pa.table({"x": np.arange(5,
+                                                   dtype=np.int64)}), p)
+        s = TpuSession()
+        s.set("spark.rapids.sql.format.orc.reader.type", "PERFILE")
+        df = s.read.orc(p)
+        phys = df._physical()
+        scan = phys.root
+        while scan.children:
+            scan = scan.children[0]
+        assert scan._reader_type(ExecContext(phys.conf)) == "PERFILE"
+        assert df.collect() == [(i,) for i in range(5)]
+
+    def test_write_gate_uses_host_engine(self, tmp_path):
+        from spark_rapids_tpu import FLOAT64, INT64
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        import pyarrow.parquet as papq
+        import os
+        s = TpuSession()
+        s.set("spark.rapids.sql.format.parquet.write.enabled", False)
+        df = s.create_dataframe({"x": [1, 2, 3]}, [("x", INT64)])
+        out = str(tmp_path / "w")
+        stats = df.write.parquet(out)
+        assert stats["numOutputRows"] == 3
+        files = [f for f in os.listdir(out) if f.endswith(".parquet")]
+        rows = sum(papq.read_table(os.path.join(out, f)).num_rows
+                   for f in files)
+        assert rows == 3
+
+    def test_memory_ceiling_and_reserve(self):
+        from spark_rapids_tpu.ops.base import ExecContext, \
+            _visible_device_bytes
+        from spark_rapids_tpu.config import TpuConf
+        visible = _visible_device_bytes()
+        conf = TpuConf({
+            "spark.rapids.memory.tpu.allocFraction": 0.9,
+            "spark.rapids.memory.tpu.maxAllocFraction": 0.5,
+            "spark.rapids.memory.tpu.reserve": 1024,
+        })
+        ctx = ExecContext(conf)
+        assert ctx.catalog.device_budget == int(visible * 0.5) - 1024
+        ctx.close()
+
+    def test_metrics_level_filters(self):
+        from spark_rapids_tpu import FLOAT64, INT64
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        from spark_rapids_tpu.plan.logical import agg_count
+        s = TpuSession()
+        df = s.create_dataframe({"x": [1, 2, 3]}, [("x", INT64)]) \
+            .agg(agg_count().alias("n"))
+        df.collect()
+        s.set("spark.rapids.sql.metrics.level", "ESSENTIAL")
+        df.collect()    # re-plan under the new conf version
+        m = df.metrics()
+        allowed = {"numOutputRows", "totalTime"}
+        assert m and all(set(v) <= allowed for v in m.values())

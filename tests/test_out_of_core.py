@@ -49,3 +49,30 @@ def test_sort_in_core_path_unchanged():
                             num_partitions=3) \
         .order_by(col("k").desc(), col("v").asc())
     assert df.collect() == df.collect_host()
+
+
+def test_window_larger_than_device_budget():
+    """Partition-chunked windows (the other half of VERDICT item 7):
+    a partitioned window over data beyond the device budget range-splits
+    by partition key and matches the host oracle."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+    n = 40_000
+    rng = np.random.default_rng(11)
+    data = {"g": rng.integers(0, 500, n).tolist(),
+            "v": rng.normal(size=n).tolist()}
+    s = _session(96 * 1024)
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    df = s.create_dataframe(data, [("g", INT64), ("v", FLOAT64)],
+                            num_partitions=8)
+    from spark_rapids_tpu.plan.logical import Window
+    w = Window.partition_by(col("g"))
+    out = df.with_column("s", agg_sum(col("v")).over(w))
+    got = sorted(out.collect())
+    want = sorted(out.collect_host())
+    assert len(got) == n
+    for a, b in zip(got, want):
+        assert a[:2] == b[:2] and abs(a[2] - b[2]) < 1e-9
+    phys = out._physical()
+    wms = [m.values for k, m in phys.last_ctx.metrics.items()
+           if "WindowExec" in k]
+    assert any(v.get("outOfCoreBuckets", 0) >= 2 for v in wms)

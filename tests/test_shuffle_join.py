@@ -311,3 +311,21 @@ class TestJoinReviewRegressions:
             [SortOrder(Ref(0, dt.INT32))], 3))
         dev = compare_engines(ex, sort_result=True)
         assert len(dev) == 6
+
+
+def test_nested_loop_with_filtered_small_build():
+    """A small filtered build side keeps its selection vector past the
+    broadcast (no shrink pull) — the NLJ must not pair probe rows with
+    sel-deleted build rows."""
+    from spark_rapids_tpu import FLOAT64, INT64
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.plan.logical import col
+    s = TpuSession()
+    left = s.create_dataframe({"a": [1, 2, 3]}, [("a", INT64)])
+    right = s.create_dataframe({"b": [10, 20, 30, 40]}, [("b", INT64)]) \
+        .filter(col("b") >= 30)
+    j = left.cross_join(right)
+    got = sorted(j.collect())
+    want = sorted(j.collect_host())
+    assert got == want
+    assert len(got) == 6        # 3 x 2, not 3 x 4
