@@ -424,4 +424,18 @@ def generate_docs() -> str:
             continue
         default = "None" if e.default is None else str(e.default)
         lines.append(f"| {e.key} | {e.doc} | {default} |")
+    lines += [
+        "",
+        "## Dynamic per-rule kill switches",
+        "",
+        "Beyond the registered keys, every planner rule accepts a boolean",
+        "kill switch (RapidsMeta confKey analog, default true):",
+        "",
+        "- `spark.rapids.sql.exec.<ExecName>` — disable one physical",
+        "  operator (e.g. `spark.rapids.sql.exec.LogicalJoin`); the plan",
+        "  falls back to the host engine there with an explain reason.",
+        "- `spark.rapids.sql.expression.<kind>` — disable one expression",
+        "  kind (e.g. `spark.rapids.sql.expression.upper`); the enclosing",
+        "  operator falls back with a reason naming the expression.",
+    ]
     return "\n".join(lines) + "\n"

@@ -57,14 +57,26 @@ def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS):
 
 def all_to_all_exchange(batch: DeviceBatch, pids: jnp.ndarray,
                         n_devices: int,
-                        axis: str = DATA_AXIS) -> DeviceBatch:
+                        axis: str = DATA_AXIS,
+                        piece_capacity: Optional[int] = None
+                        ) -> DeviceBatch:
     """ICI hash-shuffle step for one device's shard (call under shard_map).
 
     Splits the local batch into per-destination pieces, exchanges piece
     ownership with ``all_to_all`` (one fused ICI collective, not a peer
     pull protocol), and concatenates the received pieces.
+
+    ``piece_capacity`` is the static per-destination piece size. Default
+    (None) is the worst case — every piece at the full shard capacity, an
+    n_devices-fold wire inflation. The planner's two-phase path
+    (SURVEY §7 sizes-then-data) exchanges COUNTS first and passes the
+    observed max, so the collective moves ~the real data volume.
     """
     pieces = split_batch(batch, pids, n_devices)
+    if piece_capacity is not None:
+        from spark_rapids_tpu.columnar.rowmove import compact_to
+        pieces = [compact_to(p, piece_capacity, p.live_count())
+                  for p in pieces]
     # Stack piece leaves -> leading axis = destination device.
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pieces)
     received = jax.lax.all_to_all(stacked, axis, split_axis=0,
@@ -74,6 +86,22 @@ def all_to_all_exchange(batch: DeviceBatch, pids: jnp.ndarray,
              for i in range(n_devices)]
     total_cap = sum(p.capacity for p in parts)
     return concat_batches(parts, bucket_capacity(total_cap))
+
+
+def exchange_counts(batch: DeviceBatch, pids: jnp.ndarray,
+                    n_devices: int, axis: str = DATA_AXIS) -> jnp.ndarray:
+    """Phase 1 of the two-phase shuffle: this device's per-destination
+    live-row counts, all_to_all'd so every device holds the counts of the
+    pieces it WILL receive — a (n_devices,) int32 collective, the
+    metadata exchange that replaces the reference's UCX metadata round
+    (SURVEY §2.6)."""
+    live = batch.row_mask()
+    key = jnp.where(live, pids, n_devices)
+    counts = jax.ops.segment_sum(
+        jnp.ones((batch.capacity,), jnp.int32), key,
+        num_segments=n_devices + 1)[:n_devices]
+    return jax.lax.all_to_all(counts[:, None], axis, split_axis=0,
+                              concat_axis=0, tiled=False).reshape(-1)
 
 
 def all_gather_batch(batch: DeviceBatch, n_devices: int,

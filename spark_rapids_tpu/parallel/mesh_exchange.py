@@ -132,7 +132,8 @@ class MeshExchangeExec(Exec):
     def __init__(self, child: Exec, partitioning: Partitioning):
         super().__init__(child)
         self.partitioning = partitioning
-        self._step = None
+        self._steps = {}        # piece_capacity -> jitted collective
+        self._counts_jit = None
 
     @property
     def schema(self) -> Schema:
@@ -141,16 +142,38 @@ class MeshExchangeExec(Exec):
     def num_partitions(self, ctx) -> int:
         return self.partitioning.num_partitions
 
-    def _build_step(self, mesh, n: int):
+    def _pids_step(self, mesh):
+        """Per-shard partition ids, computed ONCE and fed to both the
+        counts and data collectives (murmur/bound-compare over every row
+        is not free twice)."""
         part = self.partitioning
 
         def local(stacked):
             b = jax.tree.map(lambda x: x[0], stacked)
-            out = M.all_to_all_exchange(b, part.partition_ids(b), n)
-            return jax.tree.map(lambda x: x[None], out)
+            return part.partition_ids(b)[None]
 
         return jax.jit(shard_map(local, mesh, in_specs=(P(M.DATA_AXIS),),
                                  out_specs=P(M.DATA_AXIS)))
+
+    def _build_step(self, mesh, n: int, piece_capacity=None):
+        def local(stacked, pids):
+            b = jax.tree.map(lambda x: x[0], stacked)
+            out = M.all_to_all_exchange(b, pids[0], n,
+                                        piece_capacity=piece_capacity)
+            return jax.tree.map(lambda x: x[None], out)
+
+        return jax.jit(shard_map(
+            local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
+            out_specs=P(M.DATA_AXIS)))
+
+    def _counts_step(self, mesh, n: int):
+        def local(stacked, pids):
+            b = jax.tree.map(lambda x: x[0], stacked)
+            return M.exchange_counts(b, pids[0], n)[None]
+
+        return jax.jit(shard_map(
+            local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
+            out_specs=P(M.DATA_AXIS)))
 
     def _materialize(self, ctx) -> List[DeviceBatch]:
         key = f"meshx:{id(self):x}"
@@ -170,9 +193,29 @@ class MeshExchangeExec(Exec):
         with timed(m, "shuffleTime"):
             shards = _uniform_shards(per_dev, self.schema)
             stacked = M.shard_batches(mesh, shards)
-            if self._step is None:
-                self._step = self._build_step(mesh, n)
-            out = self._step(stacked)
+            # Two-phase sizes-then-data (SURVEY §7 hard part 6): exchange
+            # per-destination COUNTS first (a (n,n) int32 collective +
+            # one host pull), size the data collective's static piece
+            # capacity to the observed max instead of the worst case —
+            # the default padding is an n-fold wire inflation at scale.
+            # n == 1 skips the phase: the collective moves nothing, so
+            # the counts sync could only cost.
+            if getattr(self, "_pids_jit", None) is None:
+                self._pids_jit = self._pids_step(mesh)
+            pids = self._pids_jit(stacked)
+            piece_cap = None
+            if n > 1:
+                if self._counts_jit is None:
+                    self._counts_jit = self._counts_step(mesh, n)
+                counts = np.asarray(self._counts_jit(stacked, pids))
+                piece_cap = bucket_capacity(max(int(counts.max()), 1))
+                if piece_cap >= shards[0].capacity:
+                    piece_cap = None    # padding wouldn't shrink anything
+            step = self._steps.get(piece_cap)
+            if step is None:
+                step = self._build_step(mesh, n, piece_capacity=piece_cap)
+                self._steps[piece_cap] = step
+            out = step(stacked, pids)
             parts = _addressable_parts(out, n)
         ctx.cache[key] = parts
         return parts
