@@ -53,3 +53,15 @@ def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:
         if catalog is None or catalog.handle_oom() == 0:
             raise
         return fn(*args, **kwargs)
+
+
+def is_transient_error(e: BaseException) -> bool:
+    """Backend/tunnel failures worth one whole-query retry (SURVEY §5.3
+    failure detection: the reference leans on Spark task retry; this
+    engine owns the retry itself). Deliberately narrow — deterministic
+    errors must not run twice."""
+    s = f"{type(e).__name__}: {e}"
+    return any(marker in s for marker in (
+        "UNAVAILABLE", "DEADLINE_EXCEEDED", "connection reset",
+        "Connection reset", "Socket closed", "ABORTED",
+        "failed to connect", "stream terminated"))

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from spark_rapids_tpu.parallel.mesh_compat import shard_map
 
 from spark_rapids_tpu.columnar.batch import (
-    DeviceBatch, bucket_capacity, concat_batches)
+    DeviceBatch, DeviceColumn, bucket_capacity, concat_batches)
 from spark_rapids_tpu.parallel.partitioning import Partitioning, split_batch
 
 DATA_AXIS = "data"
@@ -74,9 +74,18 @@ def all_to_all_exchange(batch: DeviceBatch, pids: jnp.ndarray,
     """
     pieces = split_batch(batch, pids, n_devices)
     if piece_capacity is not None:
-        from spark_rapids_tpu.columnar.rowmove import compact_to
-        pieces = [compact_to(p, piece_capacity, p.live_count())
-                  for p in pieces]
+        # split_batch pieces are already packed prefixes; truncating to
+        # the exchanged max is a static slice, not another scatter pass.
+        def trunc(p: DeviceBatch) -> DeviceBatch:
+            cols = tuple(
+                DeviceColumn(c.dtype, c.data[:piece_capacity],
+                             c.validity[:piece_capacity],
+                             c.lengths[:piece_capacity]
+                             if c.dtype.is_string else None)
+                for c in p.columns)
+            return DeviceBatch(
+                cols, jnp.minimum(p.num_rows, piece_capacity))
+        pieces = [trunc(p) for p in pieces]
     # Stack piece leaves -> leading axis = destination device.
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pieces)
     received = jax.lax.all_to_all(stacked, axis, split_axis=0,

@@ -45,6 +45,12 @@ def mesh_size() -> int:
     return len(jax.devices())
 
 
+# Shards below this many rows skip the two-phase counts exchange: its
+# blocking host pull (~70ms floor on a tunneled link) costs more than the
+# worst-case padding it would avoid. Module-level so tests can lower it.
+TWO_PHASE_MIN_SHARD_ROWS = 1 << 18
+
+
 def _uniform_shards(batches_per_dev: List[List[DeviceBatch]],
                     schema: Schema) -> List[DeviceBatch]:
     """Coalesce each device's batches and pad all shards to one common
@@ -204,7 +210,7 @@ class MeshExchangeExec(Exec):
                 self._pids_jit = self._pids_step(mesh)
             pids = self._pids_jit(stacked)
             piece_cap = None
-            if n > 1:
+            if n > 1 and shards[0].capacity >= TWO_PHASE_MIN_SHARD_ROWS:
                 if self._counts_jit is None:
                     self._counts_jit = self._counts_step(mesh, n)
                 counts = np.asarray(self._counts_jit(stacked, pids))

@@ -418,11 +418,28 @@ class PhysicalPlan:
         return "\n".join(lines)
 
     def collect(self, ctx=None):
+        from spark_rapids_tpu.memory.oom import is_transient_error
         from spark_rapids_tpu.ops.base import ExecContext
         owned = ctx is None
         ctx = ctx or ExecContext(self.conf)
         try:
-            return self.root.collect(ctx, device=self.root_on_device)
+            try:
+                return self.root.collect(ctx, device=self.root_on_device)
+            except Exception as e:
+                # Failure recovery (SURVEY §5.3): a transient backend /
+                # tunnel error retries the whole query ONCE on a fresh
+                # context (per-query caches — shuffles, broadcasts,
+                # built sides — are context-scoped, so the rerun is
+                # clean). Owned contexts only: a caller-provided context
+                # may hold state the caller still needs.
+                if not owned or not is_transient_error(e):
+                    raise
+                import logging
+                logging.getLogger("spark_rapids_tpu").warning(
+                    "transient device error, retrying query once: %s", e)
+                ctx.close()
+                ctx = ExecContext(self.conf)
+                return self.root.collect(ctx, device=self.root_on_device)
         finally:
             # Metrics survive the collect for DataFrame.metrics().
             self.last_ctx = ctx

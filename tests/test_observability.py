@@ -63,3 +63,35 @@ def test_memory_debug_leak_report(tmp_path, caplog):
     with caplog.at_level(logging.WARNING, "spark_rapids_tpu.memory"):
         cat.close()
     assert any("leaked" in r.message for r in caplog.records)
+
+
+def test_transient_error_retries_query_once(monkeypatch):
+    """Failure recovery (SURVEY 5.3): a transient backend error retries
+    the whole query on a fresh context; deterministic errors do not."""
+    from spark_rapids_tpu.plan.logical import agg_count
+    s = TpuSession()
+    df = _df(s).agg(agg_count().alias("n"))
+    phys = df._physical()
+    calls = {"n": 0}
+    orig = type(phys.root).collect
+
+    def flaky(self, ctx, device=True):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: Socket closed")
+        return orig(self, ctx, device)
+
+    monkeypatch.setattr(type(phys.root), "collect", flaky)
+    assert phys.collect() == [(6,)]
+    assert calls["n"] == 2
+
+    calls["n"] = 0
+
+    def hard(self, ctx, device=True):
+        calls["n"] += 1
+        raise ValueError("deterministic bug")
+
+    monkeypatch.setattr(type(phys.root), "collect", hard)
+    with pytest.raises(ValueError):
+        phys.collect()
+    assert calls["n"] == 1
