@@ -230,3 +230,13 @@ class TestFormatAndMemoryGates:
         m = df.metrics()
         allowed = {"numOutputRows", "totalTime"}
         assert m and all(set(v) <= allowed for v in m.values())
+
+
+def test_generated_docs_in_sync():
+    """docs/configs.md is the generated config reference (the reference's
+    generated docs/configs.md discipline) — regen must be a no-op."""
+    import os
+    from spark_rapids_tpu.config import generate_docs
+    path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                        "configs.md")
+    assert open(path).read() == generate_docs()
