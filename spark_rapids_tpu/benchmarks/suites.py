@@ -17,6 +17,11 @@ the CPU baseline and the result oracle.
 - ``repart`` (repartition-heavy): full hash repartition of the
   clickstream fact table followed by a per-bucket count — the shuffle
   exchange target config (single-chip stand-in for the SF10K ICI case).
+- ``ds_q3`` / ``ds_q42`` (TPC-DS q3/q42-like): fact x date x item joins
+  with grouped revenue and deterministic ordered top-100s.
+- ``ds_q89`` (TPC-DS q89-like): monthly class sales vs the class's
+  windowed monthly average with a deviation filter (join + agg +
+  window-avg shape).
 """
 
 from __future__ import annotations
@@ -228,7 +233,64 @@ def repart(session, data_dir: str):
         .agg(agg_count().alias("n")).order_by("bucket")
 
 
-QUERIES = {"q67": q67, "xbb_q5": xbb_q5, "repart": repart}
+def ds_q3(session, data_dir: str):
+    """TPC-DS q3-like: fact x date x item, November sales by year and
+    brand, revenue-ordered."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+    ss = _read(session, data_dir, "store_sales")
+    dd = _read(session, data_dir, "date_dim").filter(col("d_moy") == 11)
+    it = _read(session, data_dir, "item") \
+        .filter(col("i_category_id") == 1)
+    return ss.join_on(dd, ["ss_sold_date_sk"], ["d_date_sk"]) \
+        .join_on(it, ["ss_item_sk"], ["i_item_sk"]) \
+        .group_by("d_year", "i_brand") \
+        .agg(agg_sum(col("ss_sales_price")).alias("sum_agg")) \
+        .order_by(col("d_year").asc(), col("sum_agg").desc(),
+                  col("i_brand").asc()) \
+        .limit(100)
+
+
+def ds_q42(session, data_dir: str):
+    """TPC-DS q42-like: category revenue for one year by quarter."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+    ss = _read(session, data_dir, "store_sales")
+    dd = _read(session, data_dir, "date_dim") \
+        .filter(col("d_year") == 1999)
+    it = _read(session, data_dir, "item")
+    return ss.join_on(dd, ["ss_sold_date_sk"], ["d_date_sk"]) \
+        .join_on(it, ["ss_item_sk"], ["i_item_sk"]) \
+        .group_by("d_year", "d_qoy", "i_category") \
+        .agg(agg_sum(col("ss_sales_price")).alias("revenue")) \
+        .order_by(col("revenue").desc(), col("d_year").asc(),
+                  col("d_qoy").asc(), col("i_category").asc()) \
+        .limit(100)
+
+
+def ds_q89(session, data_dir: str):
+    """TPC-DS q89-like: monthly class sales vs the class's yearly monthly
+    average (windowed avg + deviation filter)."""
+    from spark_rapids_tpu.plan.logical import (
+        Window, agg_avg, agg_sum, col)
+    ss = _read(session, data_dir, "store_sales")
+    dd = _read(session, data_dir, "date_dim") \
+        .filter(col("d_year") == 1999)
+    it = _read(session, data_dir, "item")
+    monthly = ss.join_on(dd, ["ss_sold_date_sk"], ["d_date_sk"]) \
+        .join_on(it, ["ss_item_sk"], ["i_item_sk"]) \
+        .group_by("i_category", "i_class", "d_moy") \
+        .agg(agg_sum(col("ss_sales_price")).alias("sum_sales"))
+    w = Window.partition_by("i_category", "i_class")
+    out = monthly.with_column("avg_monthly_sales",
+                              agg_avg(col("sum_sales")).over(w))
+    return out.filter(
+        (col("sum_sales") - col("avg_monthly_sales"))
+        / col("avg_monthly_sales") > 0.1) \
+        .order_by(col("i_category").asc(), col("i_class").asc(),
+                  col("d_moy").asc())
+
+
+QUERIES = {"q67": q67, "xbb_q5": xbb_q5, "repart": repart,
+           "ds_q3": ds_q3, "ds_q42": ds_q42, "ds_q89": ds_q89}
 
 
 # ---------------------------------------------------------------------------
@@ -315,6 +377,53 @@ def pandas_query(name: str, data_dir: str):
         bucket = ((h.astype(np.int64) % REPART_N) + REPART_N) % REPART_N
         counts = pd.Series(bucket).value_counts().sort_index()
         return [(int(b), int(n)) for b, n in counts.items()]
+    if name == "ds_q3":
+        ss = read("store_sales", ["ss_sold_date_sk", "ss_item_sk",
+                                  "ss_sales_price"])
+        dd = read("date_dim", ["d_date_sk", "d_year", "d_moy"])
+        dd = dd[dd.d_moy == 11]
+        it = read("item", ["i_item_sk", "i_brand", "i_category_id"])
+        it = it[it.i_category_id == 1]
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = j.groupby(["d_year", "i_brand"], as_index=False) \
+            .agg(sum_agg=("ss_sales_price", "sum"))
+        g = g.sort_values(["d_year", "sum_agg", "i_brand"],
+                          ascending=[True, False, True]).head(100)
+        return [tuple(r) for r in g.itertuples(index=False)]
+    if name == "ds_q42":
+        ss = read("store_sales", ["ss_sold_date_sk", "ss_item_sk",
+                                  "ss_sales_price"])
+        dd = read("date_dim", ["d_date_sk", "d_year", "d_qoy"])
+        dd = dd[dd.d_year == 1999]
+        it = read("item", ["i_item_sk", "i_category"])
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = j.groupby(["d_year", "d_qoy", "i_category"], as_index=False) \
+            .agg(revenue=("ss_sales_price", "sum"))
+        g = g.sort_values(["revenue", "d_year", "d_qoy", "i_category"],
+                          ascending=[False, True, True, True]).head(100)
+        out = g[["d_year", "d_qoy", "i_category", "revenue"]]
+        return [tuple(r) for r in out.itertuples(index=False)]
+    if name == "ds_q89":
+        ss = read("store_sales", ["ss_sold_date_sk", "ss_item_sk",
+                                  "ss_sales_price"])
+        dd = read("date_dim", ["d_date_sk", "d_year", "d_moy"])
+        dd = dd[dd.d_year == 1999]
+        it = read("item", ["i_item_sk", "i_category", "i_class"])
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = j.groupby(["i_category", "i_class", "d_moy"],
+                      as_index=False).agg(sum_sales=("ss_sales_price",
+                                                     "sum"))
+        g["avg_monthly_sales"] = g.groupby(
+            ["i_category", "i_class"]).sum_sales.transform("mean")
+        g = g[(g.sum_sales - g.avg_monthly_sales)
+              / g.avg_monthly_sales > 0.1]
+        g = g.sort_values(["i_category", "i_class", "d_moy"])
+        out = g[["i_category", "i_class", "d_moy", "sum_sales",
+                 "avg_monthly_sales"]]
+        return [tuple(r) for r in out.itertuples(index=False)]
     raise KeyError(name)
 
 

@@ -1,0 +1,25 @@
+"""Suite-query parity: every benchmarks/suites.py query (TPC-DS- and
+TPCxBB-like) matches its pandas oracle at a small scale factor."""
+
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import suites
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("suites_small")
+    suites.generate(str(d), scale=0.01, files_per_table=2)
+    return str(d)
+
+
+@pytest.mark.parametrize("qn", sorted(suites.QUERIES))
+def test_suite_query_matches_pandas(qn, data_dir):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.hasNans", False)
+    got = suites.QUERIES[qn](s, data_dir).collect()
+    want = suites.pandas_query(qn, data_dir)
+    assert suites.check_result(qn, got, want), (
+        f"{qn}: device diverges\n got[:3]={got[:3]}\nwant[:3]={want[:3]}")
