@@ -22,6 +22,9 @@ the CPU baseline and the result oracle.
 - ``ds_q89`` (TPC-DS q89-like): monthly class sales vs the class's
   windowed monthly average with a deviation filter (join + agg +
   window-avg shape).
+- ``ds_q55`` (TPC-DS q55-like): one month's brand revenue top-100.
+- ``ds_q98`` (TPC-DS q98-like): class revenue share of its category via
+  a whole-partition window SUM ratio.
 """
 
 from __future__ import annotations
@@ -289,8 +292,46 @@ def ds_q89(session, data_dir: str):
                   col("d_moy").asc())
 
 
+def ds_q55(session, data_dir: str):
+    """TPC-DS q55-like: one month's brand revenue, top-100."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+    ss = _read(session, data_dir, "store_sales")
+    dd = _read(session, data_dir, "date_dim") \
+        .filter((col("d_moy") == 12) & (col("d_year") == 1998))
+    it = _read(session, data_dir, "item")
+    return ss.join_on(dd, ["ss_sold_date_sk"], ["d_date_sk"]) \
+        .join_on(it, ["ss_item_sk"], ["i_item_sk"]) \
+        .group_by("i_brand") \
+        .agg(agg_sum(col("ss_sales_price")).alias("ext_price")) \
+        .order_by(col("ext_price").desc(), col("i_brand").asc()) \
+        .limit(100)
+
+
+def ds_q98(session, data_dir: str):
+    """TPC-DS q98-like: class revenue with its share of the category
+    total (window SUM ratio)."""
+    from spark_rapids_tpu.plan.logical import Window, agg_sum, col
+    ss = _read(session, data_dir, "store_sales")
+    dd = _read(session, data_dir, "date_dim") \
+        .filter(col("d_year") == 1999)
+    it = _read(session, data_dir, "item") \
+        .filter(col("i_category").isin("Books", "Home", "Sports"))
+    per_class = ss.join_on(dd, ["ss_sold_date_sk"], ["d_date_sk"]) \
+        .join_on(it, ["ss_item_sk"], ["i_item_sk"]) \
+        .group_by("i_category", "i_class") \
+        .agg(agg_sum(col("ss_sales_price")).alias("itemrevenue"))
+    w = Window.partition_by("i_category")
+    return per_class \
+        .with_column("cat_total", agg_sum(col("itemrevenue")).over(w)) \
+        .select(col("i_category"), col("i_class"), col("itemrevenue"),
+                (col("itemrevenue") * 100.0 / col("cat_total"))
+                .alias("revenueratio")) \
+        .order_by(col("i_category").asc(), col("i_class").asc())
+
+
 QUERIES = {"q67": q67, "xbb_q5": xbb_q5, "repart": repart,
-           "ds_q3": ds_q3, "ds_q42": ds_q42, "ds_q89": ds_q89}
+           "ds_q3": ds_q3, "ds_q42": ds_q42, "ds_q89": ds_q89,
+           "ds_q55": ds_q55, "ds_q98": ds_q98}
 
 
 # ---------------------------------------------------------------------------
@@ -424,6 +465,35 @@ def pandas_query(name: str, data_dir: str):
         out = g[["i_category", "i_class", "d_moy", "sum_sales",
                  "avg_monthly_sales"]]
         return [tuple(r) for r in out.itertuples(index=False)]
+    if name == "ds_q55":
+        ss = read("store_sales", ["ss_sold_date_sk", "ss_item_sk",
+                                  "ss_sales_price"])
+        dd = read("date_dim", ["d_date_sk", "d_year", "d_moy"])
+        dd = dd[(dd.d_moy == 12) & (dd.d_year == 1998)]
+        it = read("item", ["i_item_sk", "i_brand"])
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = j.groupby("i_brand", as_index=False) \
+            .agg(ext_price=("ss_sales_price", "sum"))
+        g = g.sort_values(["ext_price", "i_brand"],
+                          ascending=[False, True]).head(100)
+        out = g[["i_brand", "ext_price"]]
+        return [tuple(r) for r in out.itertuples(index=False)]
+    if name == "ds_q98":
+        ss = read("store_sales", ["ss_sold_date_sk", "ss_item_sk",
+                                  "ss_sales_price"])
+        dd = read("date_dim", ["d_date_sk", "d_year"])
+        dd = dd[dd.d_year == 1999]
+        it = read("item", ["i_item_sk", "i_category", "i_class"])
+        it = it[it.i_category.isin(["Books", "Home", "Sports"])]
+        j = ss.merge(dd, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+            .merge(it, left_on="ss_item_sk", right_on="i_item_sk")
+        g = j.groupby(["i_category", "i_class"], as_index=False) \
+            .agg(itemrevenue=("ss_sales_price", "sum"))
+        tot = g.groupby("i_category").itemrevenue.transform("sum")
+        g["revenueratio"] = g.itemrevenue * 100.0 / tot
+        g = g.sort_values(["i_category", "i_class"])
+        return [tuple(r) for r in g.itertuples(index=False)]
     raise KeyError(name)
 
 
