@@ -1,23 +1,4 @@
-"""shard_map import shim across jax versions."""
+"""Back-compat re-export: the shard_map shim moved into the shims SPI
+(spark_rapids_tpu.shims, the SparkShims.scala:61 analog)."""
 
-from __future__ import annotations
-
-import jax
-
-
-def shard_map(fn, mesh, in_specs, out_specs):
-    """Version-tolerant shard_map: newer jax exposes jax.shard_map; older
-    versions use jax.experimental.shard_map.shard_map with check_rep."""
-    if hasattr(jax, "shard_map"):
-        try:
-            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs, check_vma=False)
-        except TypeError:
-            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                                 out_specs=out_specs)
-    from jax.experimental.shard_map import shard_map as _sm
-    try:
-        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_rep=False)
-    except TypeError:
-        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+from spark_rapids_tpu.shims import shard_map  # noqa: F401

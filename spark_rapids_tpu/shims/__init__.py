@@ -1,0 +1,68 @@
+"""JAX version shim SPI (ref: SparkShims.scala:61 + the per-version shim
+layer, sql-plugin/.../shims/).
+
+The reference abstracts Spark's breaking API drift behind a shim
+provider chosen at runtime; this engine's moving substrate is JAX, whose
+public API drifts the same way (shard_map's home and kwargs, the tree
+API's module, pytree registration). Every version-sensitive touchpoint
+routes through this package so a JAX upgrade is a one-file change, and
+``provider()`` names the resolved shim for diagnostics (the
+SparkShimServiceProvider.matchesVersion analog)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+
+def jax_version() -> Tuple[int, ...]:
+    return tuple(int(x) for x in jax.__version__.split(".")[:3]
+                 if x.isdigit())
+
+
+def provider() -> str:
+    """Human-readable name of the resolved shim set."""
+    flavor = "jax-native-shard-map" if hasattr(jax, "shard_map") \
+        else "jax-experimental-shard-map"
+    return f"jax {jax.__version__} ({flavor}, tree={_TREE_FLAVOR})"
+
+
+# -- shard_map (moved from jax.experimental to jax; kwargs renamed) ------
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    """Version-tolerant shard_map: newer jax exposes jax.shard_map; older
+    versions use jax.experimental.shard_map.shard_map with check_rep."""
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    try:
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    except TypeError:
+        return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+# -- tree API (jax.tree since 0.4.25; jax.tree_util before) --------------
+
+if hasattr(jax, "tree") and hasattr(jax.tree, "map"):
+    _TREE_FLAVOR = "jax.tree"
+    tree_map = jax.tree.map
+    tree_flatten = jax.tree.flatten
+    tree_unflatten = jax.tree.unflatten
+else:                                           # pragma: no cover
+    _TREE_FLAVOR = "jax.tree_util"
+    tree_map = jax.tree_util.tree_map
+    tree_flatten = jax.tree_util.tree_flatten
+
+    def tree_unflatten(treedef, leaves):
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def register_pytree_node(cls, flatten, unflatten):
+    jax.tree_util.register_pytree_node(cls, flatten, unflatten)

@@ -85,3 +85,15 @@ def test_q3_mesh_plan_contains_collective_exchanges(data_dir):
     walk(phys.root)
     # Both join sides x 2 joins + the aggregate exchange.
     assert len(found) >= 4
+
+
+@pytest.mark.parametrize("qn", ["q4", "q12"])
+def test_more_queries_through_mesh_collectives(qn, data_dir):
+    """Semi-join (q4) and join+conditional-agg (q12) shapes through the
+    all_to_all mesh path match the single-device plan and the pandas
+    oracle."""
+    mesh_rows = tpch.QUERIES[qn](_session(True), data_dir).collect()
+    single_rows = tpch.QUERIES[qn](_session(False), data_dir).collect()
+    pandas_rows = tpch.pandas_query(qn, data_dir)
+    assert tpch.rows_close(sorted(mesh_rows), sorted(single_rows))
+    assert tpch.check_result(qn, mesh_rows, pandas_rows)
