@@ -28,7 +28,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from spark_rapids_tpu.parallel.mesh_compat import shard_map
+from spark_rapids_tpu.shims import (shard_map, tree_flatten,
+                                    tree_map, tree_unflatten)
 
 from spark_rapids_tpu.columnar.batch import (
     DeviceBatch, DeviceColumn, bucket_capacity, concat_batches)
@@ -87,11 +88,11 @@ def all_to_all_exchange(batch: DeviceBatch, pids: jnp.ndarray,
                 cols, jnp.minimum(p.num_rows, piece_capacity))
         pieces = [trunc(p) for p in pieces]
     # Stack piece leaves -> leading axis = destination device.
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *pieces)
+    stacked = tree_map(lambda *xs: jnp.stack(xs), *pieces)
     received = jax.lax.all_to_all(stacked, axis, split_axis=0,
                                   concat_axis=0, tiled=False)
     # received leaf shape == stacked leaf shape; index i = piece from peer i.
-    parts = [jax.tree.map(lambda x, i=i: x[i], received)
+    parts = [tree_map(lambda x, i=i: x[i], received)
              for i in range(n_devices)]
     total_cap = sum(p.capacity for p in parts)
     return concat_batches(parts, bucket_capacity(total_cap))
@@ -119,7 +120,7 @@ def all_gather_batch(batch: DeviceBatch, n_devices: int,
     the one-time all-gather replacing collect+torrent-broadcast+re-upload).
     """
     gathered = jax.lax.all_gather(batch, axis, axis=0, tiled=False)
-    parts = [jax.tree.map(lambda x, i=i: x[i], gathered)
+    parts = [tree_map(lambda x, i=i: x[i], gathered)
              for i in range(n_devices)]
     total_cap = sum(p.capacity for p in parts)
     return concat_batches(parts, bucket_capacity(total_cap))
@@ -154,9 +155,9 @@ def distributed_aggregate_step(mesh: Mesh, agg_exec,
 
     def wrapped(stacked_local):
         # in_specs P(axis) leaves a unit device axis on each leaf locally.
-        local = jax.tree.map(lambda x: x[0], stacked_local)
+        local = tree_map(lambda x: x[0], stacked_local)
         out = step(local)
-        return jax.tree.map(lambda x: x[None], out)
+        return tree_map(lambda x: x[None], out)
 
     sharded = shard_map(wrapped, mesh, in_specs=(P(axis),),
                         out_specs=P(axis))
@@ -214,10 +215,10 @@ def distributed_join_agg_step(mesh: Mesh, join_exec, agg_exec,
         return agg_exec._finalize_batch(merged), overflow
 
     def wrapped(l_stacked, r_stacked):
-        left = jax.tree.map(lambda x: x[0], l_stacked)
-        right = jax.tree.map(lambda x: x[0], r_stacked)
+        left = tree_map(lambda x: x[0], l_stacked)
+        right = tree_map(lambda x: x[0], r_stacked)
         out, overflow = step(left, right)
-        return (jax.tree.map(lambda x: x[None], out), overflow[None])
+        return (tree_map(lambda x: x[None], out), overflow[None])
 
     sharded = shard_map(wrapped, mesh, in_specs=(P(axis), P(axis)),
                         out_specs=P(axis))
@@ -228,6 +229,6 @@ def shard_batches(mesh: Mesh, per_device: List[DeviceBatch],
                   axis: str = DATA_AXIS) -> DeviceBatch:
     """Assemble per-device shards into one globally-sharded DeviceBatch
     (leaves get a leading device axis mapped onto the mesh)."""
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_device)
+    stacked = tree_map(lambda *xs: jnp.stack(xs), *per_device)
     sharding = NamedSharding(mesh, P(axis))
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), stacked)
+    return tree_map(lambda x: jax.device_put(x, sharding), stacked)

@@ -28,7 +28,8 @@ from spark_rapids_tpu.columnar.batch import (
     DeviceBatch, DeviceColumn, bucket_capacity, string_repad)
 from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
 from spark_rapids_tpu.parallel import mesh as M
-from spark_rapids_tpu.parallel.mesh_compat import shard_map
+from spark_rapids_tpu.shims import (shard_map, tree_flatten,
+                                    tree_map, tree_unflatten)
 from spark_rapids_tpu.parallel.partitioning import Partitioning
 
 
@@ -114,7 +115,7 @@ def _addressable_parts(out, n: int):
     freely (concat across buckets), so every shard is eagerly
     ``device_put`` onto the default device — an explicit transfer now, not
     a lazy gather later."""
-    leaves, treedef = jax.tree.flatten(out)
+    leaves, treedef = tree_flatten(out)
     per_dev = [[] for _ in range(n)]
     for leaf in leaves:
         by_row = {}
@@ -129,7 +130,7 @@ def _addressable_parts(out, n: int):
     # ONE batched transfer for every shard of every partition (device_put
     # takes pytrees) — not a put per leaf per device.
     per_dev = jax.device_put(per_dev, jax.devices()[0])
-    return [jax.tree.unflatten(treedef, ls) for ls in per_dev]
+    return [tree_unflatten(treedef, ls) for ls in per_dev]
 
 
 class MeshExchangeExec(Exec):
@@ -155,7 +156,7 @@ class MeshExchangeExec(Exec):
         part = self.partitioning
 
         def local(stacked):
-            b = jax.tree.map(lambda x: x[0], stacked)
+            b = tree_map(lambda x: x[0], stacked)
             return part.partition_ids(b)[None]
 
         return jax.jit(shard_map(local, mesh, in_specs=(P(M.DATA_AXIS),),
@@ -163,10 +164,10 @@ class MeshExchangeExec(Exec):
 
     def _build_step(self, mesh, n: int, piece_capacity=None):
         def local(stacked, pids):
-            b = jax.tree.map(lambda x: x[0], stacked)
+            b = tree_map(lambda x: x[0], stacked)
             out = M.all_to_all_exchange(b, pids[0], n,
                                         piece_capacity=piece_capacity)
-            return jax.tree.map(lambda x: x[None], out)
+            return tree_map(lambda x: x[None], out)
 
         return jax.jit(shard_map(
             local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
@@ -174,7 +175,7 @@ class MeshExchangeExec(Exec):
 
     def _counts_step(self, mesh, n: int):
         def local(stacked, pids):
-            b = jax.tree.map(lambda x: x[0], stacked)
+            b = tree_map(lambda x: x[0], stacked)
             return M.exchange_counts(b, pids[0], n)[None]
 
         return jax.jit(shard_map(

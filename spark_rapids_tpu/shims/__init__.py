@@ -11,14 +11,7 @@ SparkShimServiceProvider.matchesVersion analog)."""
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
-
-
-def jax_version() -> Tuple[int, ...]:
-    return tuple(int(x) for x in jax.__version__.split(".")[:3]
-                 if x.isdigit())
 
 
 def provider() -> str:
@@ -59,9 +52,7 @@ else:                                           # pragma: no cover
     _TREE_FLAVOR = "jax.tree_util"
     tree_map = jax.tree_util.tree_map
     tree_flatten = jax.tree_util.tree_flatten
-
-    def tree_unflatten(treedef, leaves):
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+    tree_unflatten = jax.tree_util.tree_unflatten
 
 
 def register_pytree_node(cls, flatten, unflatten):

@@ -69,7 +69,7 @@ def test_all_gather_batch(rng):
     global_batch = M.shard_batches(mesh, shards)
 
     from jax.sharding import PartitionSpec as P
-    from spark_rapids_tpu.parallel.mesh_compat import shard_map
+    from spark_rapids_tpu.shims import shard_map
 
     def inner(stacked):
         local = jax.tree.map(lambda x: x[0], stacked)
