@@ -187,7 +187,8 @@ def main():
                "q4", "q17", "q20", "q10", "q13", "q7", "q8", "q9",
                "q18", "q21"):
         packs[qn] = (tpch, tpch_dir)
-    for qn in ("ds_q3", "ds_q42", "ds_q89", "ds_q55", "ds_q98"):
+    for qn in ("ds_q3", "ds_q42", "ds_q89", "ds_q55", "ds_q98",
+               "xbb_q12"):
         packs[qn] = (suites, suites_dir)
     # q67 last: its SF1 rollup+window first run can exceed the whole
     # budget on this chip — it must not starve the queries behind it.

@@ -25,6 +25,8 @@ the CPU baseline and the result oracle.
 - ``ds_q55`` (TPC-DS q55-like): one month's brand revenue top-100.
 - ``ds_q98`` (TPC-DS q98-like): class revenue share of its category via
   a whole-partition window SUM ratio.
+- ``xbb_q12`` (TPCxBB q12-like): distinct browsing users per category
+  (COUNT DISTINCT through the partial/merge distinct pipeline).
 """
 
 from __future__ import annotations
@@ -329,9 +331,22 @@ def ds_q98(session, data_dir: str):
         .order_by(col("i_category").asc(), col("i_class").asc())
 
 
+def xbb_q12(session, data_dir: str):
+    """TPCxBB q12-like: distinct browsing users per category (COUNT
+    DISTINCT through the partial/merge distinct pipeline)."""
+    from spark_rapids_tpu.plan.logical import agg_count_distinct, col
+    wcs = _read(session, data_dir, "web_clickstreams") \
+        .filter(col("wcs_user_sk").isNotNull())
+    it = _read(session, data_dir, "item")
+    return wcs.join_on(it, ["wcs_item_sk"], ["i_item_sk"]) \
+        .group_by("i_category") \
+        .agg(agg_count_distinct(col("wcs_user_sk")).alias("users")) \
+        .order_by(col("i_category").asc())
+
+
 QUERIES = {"q67": q67, "xbb_q5": xbb_q5, "repart": repart,
            "ds_q3": ds_q3, "ds_q42": ds_q42, "ds_q89": ds_q89,
-           "ds_q55": ds_q55, "ds_q98": ds_q98}
+           "ds_q55": ds_q55, "ds_q98": ds_q98, "xbb_q12": xbb_q12}
 
 
 # ---------------------------------------------------------------------------
@@ -493,6 +508,14 @@ def pandas_query(name: str, data_dir: str):
         tot = g.groupby("i_category").itemrevenue.transform("sum")
         g["revenueratio"] = g.itemrevenue * 100.0 / tot
         g = g.sort_values(["i_category", "i_class"])
+        return [tuple(r) for r in g.itertuples(index=False)]
+    if name == "xbb_q12":
+        wcs = read("web_clickstreams", ["wcs_user_sk", "wcs_item_sk"])
+        wcs = wcs[wcs.wcs_user_sk.notna()]
+        it = read("item", ["i_item_sk", "i_category"])
+        j = wcs.merge(it, left_on="wcs_item_sk", right_on="i_item_sk")
+        g = j.groupby("i_category", sort=True, as_index=False) \
+            .agg(users=("wcs_user_sk", "nunique"))
         return [tuple(r) for r in g.itertuples(index=False)]
     raise KeyError(name)
 
