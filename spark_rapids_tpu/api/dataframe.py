@@ -416,13 +416,11 @@ class DataFrame:
                         concat_batches(
                             batches, bucket_capacity(
                                 sum(b.capacity for b in batches)))
-                    from spark_rapids_tpu.columnar.batch import _JIT_CACHE
                     from spark_rapids_tpu.columnar.rowmove import \
                         compact_batch
-                    fn = _JIT_CACHE.get("to_jax_compact")
-                    if fn is None:
-                        fn = _jax.jit(compact_batch)
-                        _JIT_CACHE["to_jax_compact"] = fn
+                    from spark_rapids_tpu.ops import kernel_cache as kc
+                    fn = kc.lookup("compact-batch", (),
+                                   lambda: _jax.jit(compact_batch))
                     single = fn(single)
                     n = int(single.live_count())
                 finally:

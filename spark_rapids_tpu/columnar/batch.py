@@ -272,18 +272,22 @@ def concat_batches(batches: Sequence[DeviceBatch], capacity: int) -> DeviceBatch
     return concat_compact(batches, capacity)
 
 
-_JIT_CACHE: dict = {}
+def _kernel_lookup(kind: str, key_parts, builder):
+    """Process-global kernel cache access (lazy import: ops.kernel_cache
+    must stay import-cycle-free with this module)."""
+    from spark_rapids_tpu.ops import kernel_cache as kc
+    return kc.lookup(kind, key_parts, builder)
 
 
 def jit_concat_batches(batches: Sequence[DeviceBatch],
                        capacity: int) -> DeviceBatch:
-    """``concat_batches`` under jit. Cached per target capacity; jax's own
-    cache handles distinct input pytree structures. Eager concat is a
-    per-column scatter storm — under jit XLA fuses it into a few copies."""
-    fn = _JIT_CACHE.get(("concat", capacity))
-    if fn is None:
-        fn = jax.jit(lambda bs: concat_batches(bs, capacity))
-        _JIT_CACHE[("concat", capacity)] = fn
+    """``concat_batches`` under jit. Cached per target capacity in the
+    process-global kernel cache; jax's own cache handles distinct input
+    pytree structures. Eager concat is a per-column scatter storm — under
+    jit XLA fuses it into a few copies."""
+    fn = _kernel_lookup("concat", (capacity,),
+                        lambda: jax.jit(
+                            lambda bs: concat_batches(bs, capacity)))
     from spark_rapids_tpu.memory.oom import retry_on_oom
     return retry_on_oom(fn, list(batches))
 
@@ -358,17 +362,17 @@ def shrink_to_capacity(batch: DeviceBatch, capacity: int) -> DeviceBatch:
     if capacity >= batch.capacity and batch.sel is None:
         return batch
     hint = batch.rows_hint
-    fn = _JIT_CACHE.get(("shrink", capacity))
-    if fn is None:
+
+    def _build():
         def _shrink(b: DeviceBatch) -> DeviceBatch:
             from spark_rapids_tpu.columnar.rowmove import compact_to
             if b.sel is not None:
                 return compact_to(b, capacity, b.live_count())
             idx = jnp.arange(capacity, dtype=jnp.int32)
             return b.gather(idx, b.num_rows)
-        fn = jax.jit(_shrink)
-        _JIT_CACHE[("shrink", capacity)] = fn
-    out = fn(batch)
+        return jax.jit(_shrink)
+
+    out = _kernel_lookup("shrink", (capacity,), _build)(batch)
     out.rows_hint = hint
     return out
 
@@ -410,8 +414,7 @@ def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
     device-side half of range-bounds sampling (GpuRangePartitioner's
     reservoir sample): sample BEFORE downloading so a bounds probe moves
     k rows over the link instead of a whole batch."""
-    fn = _JIT_CACHE.get(("sample", k))
-    if fn is None:
+    def _build():
         def _sample(b: DeviceBatch) -> DeviceBatch:
             if b.sel is not None:
                 from spark_rapids_tpu.columnar.rowmove import compact_batch
@@ -428,9 +431,9 @@ def sample_rows(batch: DeviceBatch, k: int) -> DeviceBatch:
             idx = jnp.where(n > k, strided, jnp.minimum(slots, n - 1))
             take = jnp.minimum(jnp.asarray(k, jnp.int32), b.num_rows)
             return b.gather(idx, take)
-        fn = jax.jit(_sample)
-        _JIT_CACHE[("sample", k)] = fn
-    return fn(batch)
+        return jax.jit(_sample)
+
+    return _kernel_lookup("sample", (k,), _build)(batch)
 
 
 def string_repad(col: DeviceColumn, width: int) -> DeviceColumn:

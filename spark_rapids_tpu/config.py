@@ -344,6 +344,25 @@ MESH_ENABLED = conf("spark.rapids.sql.mesh.enabled").doc(
     "SURVEY.md §2.6 TPU mapping). Off = single-process materialized "
     "exchange.").boolean(False)
 
+STAGE_FUSION_ENABLED = conf("spark.rapids.sql.stageFusion.enabled").doc(
+    "Collapse maximal runs of contiguous row-local jittable device "
+    "operators (Project, Filter, LocalLimit, Expand) into one fused "
+    "kernel per stage — one XLA dispatch instead of one per operator, "
+    "with no materialized batch between them (the WholeStageCodegen / "
+    "GpuCoalesceBatches analog for this engine). A stage breaks at "
+    "exchanges, aggregates, sorts, joins, host-roundtrip expressions "
+    "and task-context expressions (rand, input_file_name...). Off "
+    "restores the one-Exec-one-kernel plan shape.").boolean(True)
+
+KERNEL_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.sql.kernelCache.maxEntries").doc(
+    "LRU bound on the process-global compiled-kernel cache keyed by "
+    "(expression fingerprint, input schema, capacity bucket). Repeated "
+    "queries — bench iterations, suite partitions, serving traffic — "
+    "reuse compiled programs across planner/exec instances instead of "
+    "re-tracing them; the bound caps host memory held by cached "
+    "executables.").integer(1024)
+
 DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
     "Explicit HBM budget for the buffer catalog in bytes; 0 derives it "
     "from allocFraction of the visible device memory (ref: RMM pool "
@@ -425,6 +444,36 @@ def generate_docs() -> str:
         default = "None" if e.default is None else str(e.default)
         lines.append(f"| {e.key} | {e.doc} | {default} |")
     lines += [
+        "",
+        "## Stage fusion",
+        "",
+        "With `spark.rapids.sql.stageFusion.enabled` (default true) the",
+        "planner collapses maximal runs of contiguous, row-local, jittable",
+        "device operators into a single `FusedStageExec` whose body is one",
+        "composed batch->batch function compiled as ONE kernel — a",
+        "Project->Filter->Project chain costs one XLA dispatch instead of",
+        "three, with no materialized batch between the steps.",
+        "",
+        "What fuses: `ProjectExec`, `FilterExec`, `LocalLimitExec`,",
+        "`ExpandExec` — operators whose device kernel is a pure",
+        "batch-in/batch-out function.",
+        "",
+        "What breaks a stage: exchanges (shuffle/broadcast), aggregates,",
+        "sorts, joins, windows, generate, scans, engine transitions",
+        "(host<->device bridges), host-roundtrip expressions (regexp,",
+        "pad/replace, python UDF fallbacks), and task-context expressions",
+        "(`rand`, `spark_partition_id`, `monotonically_increasing_id`,",
+        "`input_file_name`), which need the per-batch EvalContext the",
+        "unfused operator threads.",
+        "",
+        "Fused kernels (and every other operator kernel) are compiled",
+        "through the process-global kernel cache bounded by",
+        "`spark.rapids.sql.kernelCache.maxEntries`, so re-running a query",
+        "— every bench iteration, every serving request — re-traces",
+        "nothing. Cache behavior is observable per operator via the",
+        "`kernelCacheHits`/`kernelCacheMisses`/`compileTime` metrics and",
+        "fused stages are rendered in `explain`/`pretty_tree` output with",
+        "their member operator names.",
         "",
         "## Dynamic per-rule kill switches",
         "",

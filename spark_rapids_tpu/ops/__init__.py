@@ -6,6 +6,7 @@ from spark_rapids_tpu.ops.base import (         # noqa: F401
 from spark_rapids_tpu.ops.basic import (        # noqa: F401
     CoalescePartitionsExec, ExpandExec, FilterExec, GlobalLimitExec,
     LocalLimitExec, ProjectExec, RangeExec, UnionExec)
+from spark_rapids_tpu.ops.fused import FusedStageExec  # noqa: F401
 from spark_rapids_tpu.ops.sort import SortExec, SortOrder  # noqa: F401
 from spark_rapids_tpu.ops.aggregate import (    # noqa: F401
     AggSpec, Average, Count, CountStar, First, HashAggregateExec, Last, Max,

@@ -1017,20 +1017,29 @@ class HashAggregateExec(Exec):
     _has_nans = True    # set from conf before the jits are built
 
     def _jits(self):
-        """One jit wrapper per exec instance — jax caches compiled programs
-        on the wrapper, so partitions and repeated collects reuse them.
-        Keyed by the hasNans mode (it changes the traced term layout)."""
-        cache = getattr(self, "_jit_fns", None)
-        if cache is None:
-            cache = self._jit_fns = {}
-        fns = cache.get(self._has_nans)
-        if fns is None:
-            fns = (jax.jit(self._update_batch),
-                   jax.jit(self._merge_batch),
-                   jax.jit(self._finalize_batch),
-                   jax.jit(self._mixed_batch),
-                   jax.jit(self._passthrough_batch))
-            cache[self._has_nans] = fns
+        """Aggregation-stage kernels from the PROCESS-GLOBAL kernel cache,
+        keyed by the structural identity of the aggregation (mode, group
+        expressions, agg specs, hasNans term layout): a fresh query — a
+        new bench iteration, a re-planned DataFrame — reuses the compiled
+        update/merge/finalize programs instead of re-tracing them per
+        exec instance. The jitted bound methods belong to a child-severed
+        clone so a cache entry never pins the plan subtree."""
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        key = ("agg-fns", type(self).__name__, self.mode, self._has_nans,
+               kc.fingerprint(tuple(self.group_names)),
+               kc.fingerprint(tuple(self.group_exprs)),
+               kc.fingerprint(tuple(self.aggs)))
+
+        def build():
+            clone = kc.detached_clone(self)
+            clone._has_nans = self._has_nans
+            return (jax.jit(clone._update_batch),
+                    jax.jit(clone._merge_batch),
+                    jax.jit(clone._finalize_batch),
+                    jax.jit(clone._mixed_batch),
+                    jax.jit(clone._passthrough_batch))
+
+        fns, _ = kc.cache().get(key, build)
         return fns
 
     # Max batches concatenated per merge step: bounds the transient HBM of

@@ -59,9 +59,12 @@ class ExecContext:
     _catalog: Optional[object] = None
 
     def metrics_for(self, op: "Exec") -> Metrics:
-        key = f"{type(op).__name__}@{id(op):x}"
+        # Keyed/owned by op.name (not the bare class name) so fused
+        # stages report as FusedStageExec[Project->Filter->...] and the
+        # per-node metrics owner stays readable after fusion.
+        key = f"{op.name}@{id(op):x}"
         if key not in self.metrics:
-            self.metrics[key] = Metrics(owner=type(op).__name__)
+            self.metrics[key] = Metrics(owner=op.name)
         return self.metrics[key]
 
     @property

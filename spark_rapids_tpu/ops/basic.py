@@ -23,6 +23,7 @@ from spark_rapids_tpu.exprs.base import (
     eval_exprs_host)
 from spark_rapids_tpu.exprs.nondeterministic import (
     EvalContext, eval_context, needs_eval_context)
+from spark_rapids_tpu.ops import kernel_cache as kc
 from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
 
 
@@ -69,17 +70,22 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
     m = ctx.metrics_for(op)
     jittable = all(e.jittable for e in exprs)
     if jittable:
-        if getattr(op, "_ctx_jit", None) is None:
+        def build():
             def kfn(b, pid, base):
                 with eval_context(EvalContext(pid, base)):
                     out = kernel(b)
                 return out, base + b.num_rows.astype(jnp.int64)
-            op._ctx_jit = jax.jit(kfn)
+            return jax.jit(kfn)
+        fp = kc.fingerprint(tuple(exprs))
+        schema_fp = kc.schema_fingerprint(op.children[0].schema)
         pid = jnp.asarray(partition, jnp.int32)
         base = jnp.asarray(0, jnp.int64)
         for batch in op.children[0].execute_device(ctx, partition):
+            entry = kc.lookup(
+                "ctx-" + type(op).__name__,
+                (fp, schema_fp, batch.capacity), build, m)
             with timed(m):
-                out, base = op._ctx_jit(batch, pid, base)
+                out, base = kc.call(entry, m, batch, pid, base)
             m.add("numOutputBatches", 1)
             yield out
     else:
@@ -116,7 +122,6 @@ class ProjectExec(Exec):
         super().__init__(child)
         self.names = tuple(n for n, _ in projections)
         self.exprs = [e for _, e in projections]
-        self._jit = None
 
     @property
     def schema(self) -> Schema:
@@ -124,18 +129,26 @@ class ProjectExec(Exec):
                      for n, e in zip(self.names, self.exprs))
 
     def execute_device(self, ctx, partition):
-        if needs_eval_context(self.exprs):
+        exprs = list(self.exprs)
+        if needs_eval_context(exprs):
             yield from _contextual_device_loop(
-                self, self.exprs, lambda b: eval_exprs(self.exprs, b),
+                self, exprs, lambda b: eval_exprs(exprs, b),
                 ctx, partition)
             return
         m = ctx.metrics_for(self)
-        if self._jit is None and all(e.jittable for e in self.exprs):
-            self._jit = jax.jit(lambda b: eval_exprs(self.exprs, b))
-        fn = self._jit or (lambda b: eval_exprs(self.exprs, b))
+        jittable = all(e.jittable for e in exprs)
+        fp = kc.fingerprint(tuple(exprs)) if jittable else None
+        schema_fp = kc.schema_fingerprint(self.children[0].schema)
         for batch in self.children[0].execute_device(ctx, partition):
-            with timed(m):
-                out = fn(batch)
+            if jittable:
+                entry = kc.lookup(
+                    "project", (fp, schema_fp, batch.capacity),
+                    lambda: jax.jit(lambda b: eval_exprs(exprs, b)), m)
+                with timed(m):
+                    out = kc.call(entry, m, batch)
+            else:
+                with timed(m):
+                    out = eval_exprs(exprs, batch)
             # Projection preserves row count — keep the host-known hint so
             # downstream size consumers skip their device sync.
             out.rows_hint = batch.rows_hint
@@ -164,7 +177,6 @@ class FilterExec(Exec):
     def __init__(self, child: Exec, condition: Expression):
         super().__init__(child)
         self.condition = condition
-        self._jit = None
 
     @property
     def schema(self) -> Schema:
@@ -183,17 +195,30 @@ class FilterExec(Exec):
         return HostBatch(hb.names, cols)
 
     def execute_device(self, ctx, partition):
-        if needs_eval_context([self.condition]):
+        condition = self.condition
+
+        def kernel(b: DeviceBatch) -> DeviceBatch:
+            cond = as_device_column(condition.eval(b), b)
+            return b.with_sel(cond.data & cond.validity)
+
+        if needs_eval_context([condition]):
             yield from _contextual_device_loop(
-                self, [self.condition], self._kernel, ctx, partition)
+                self, [condition], kernel, ctx, partition)
             return
         m = ctx.metrics_for(self)
-        if self._jit is None and self.condition.jittable:
-            self._jit = jax.jit(self._kernel)
-        fn = self._jit or self._kernel
+        jittable = condition.jittable
+        fp = kc.fingerprint(condition) if jittable else None
+        schema_fp = kc.schema_fingerprint(self.children[0].schema)
         for batch in self.children[0].execute_device(ctx, partition):
-            with timed(m):
-                out = fn(batch)
+            if jittable:
+                entry = kc.lookup(
+                    "filter", (fp, schema_fp, batch.capacity),
+                    lambda: jax.jit(kernel), m)
+                with timed(m):
+                    out = kc.call(entry, m, batch)
+            else:
+                with timed(m):
+                    out = kernel(batch)
             m.add("numOutputBatches", 1)
             yield out
 

@@ -51,7 +51,6 @@ class GenerateExec(Exec):
         for e in self.elements[1:]:
             assert e.data_type() == t0, "array elements must share a type"
         self._elem_type = t0
-        self._jit = None
 
     @property
     def schema(self) -> Schema:
@@ -123,12 +122,19 @@ class GenerateExec(Exec):
         return expanded.compact(keep)
 
     def execute_device(self, ctx, partition):
+        from spark_rapids_tpu.ops import kernel_cache as kc
         m = ctx.metrics_for(self)
-        if self._jit is None:
-            self._jit = jax.jit(self._kernel)
+        fp = (kc.fingerprint(tuple(self.elements)), self.position,
+              self.outer, self.skip_nulls)
+        schema_fp = kc.schema_fingerprint(self.children[0].schema)
         for batch in self.children[0].execute_device(ctx, partition):
+            # The kernel is a bound method: jit a child-severed clone so
+            # the cache entry never pins the plan subtree.
+            entry = kc.lookup(
+                "generate", (fp, schema_fp, batch.capacity),
+                lambda: jax.jit(kc.detached_clone(self)._kernel), m)
             with timed(m):
-                out = self._jit(batch)
+                out = kc.call(entry, m, batch)
             m.add("numOutputBatches", 1)
             yield out
 

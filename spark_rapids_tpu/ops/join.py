@@ -210,8 +210,9 @@ def _maybe_build_dense(built: BuiltSide, batch: DeviceBatch,
     size = 1
     while size < total:
         size *= 2
-    fn = _DENSE_BUILD_JITS.get(size)
-    if fn is None:
+    from spark_rapids_tpu.ops import kernel_cache as kc
+
+    def _builder():
         def build_table(batch_, matchable, mins_, spans_, ords):
             combined = jnp.zeros((batch_.capacity,), jnp.int64)
             for i, o in enumerate(ords):
@@ -221,8 +222,9 @@ def _maybe_build_dense(built: BuiltSide, batch: DeviceBatch,
             rows = jnp.arange(batch_.capacity, dtype=jnp.int32)
             return jnp.full((size,), -1, jnp.int32).at[pos].set(
                 rows, mode="drop")
-        fn = jax.jit(build_table, static_argnames=("ords",))
-        _DENSE_BUILD_JITS[size] = fn
+        return jax.jit(build_table, static_argnames=("ords",))
+
+    fn = kc.lookup("join-dense-build", (size,), _builder)
     # The table indexes the fingerprint-SORTED batch (built.batch) — the
     # same rows every other join path gathers from.
     built.table = fn(built.batch, built.matchable,
@@ -230,9 +232,6 @@ def _maybe_build_dense(built: BuiltSide, batch: DeviceBatch,
                      jnp.asarray(spans, jnp.int64), tuple(key_ordinals))
     built.table_base = tuple(mins)
     built.table_spans = tuple(spans)
-
-
-_DENSE_BUILD_JITS: dict = {}
 
 
 def _pair_keys_equal(built: BuiltSide, b_idx: jnp.ndarray,
@@ -343,40 +342,61 @@ class _JoinKernelMixin:
     # padding waste outweighs the saved round trip.
     _FAST_PATH_MAX_RUN = 4
 
+    def _join_fp(self):
+        """Structural identity of this join's emit semantics: everything
+        ``_emit_expanded`` reads off ``self`` (join type + condition).
+        Execs with equal fingerprints share one compiled probe/emit
+        program through the process-global kernel cache."""
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        fp = getattr(self, "_join_fp_cache", None)
+        if fp is None:
+            fp = self._join_fp_cache = (
+                type(self).__name__, self.join_type,
+                kc.fingerprint(self.condition))
+        return fp
+
     def _probe_jit_fn(self):
-        """One jitted probe step per exec instance: fingerprint search +
-        expansion + gathers fused into a single device program (one
-        dispatch per probe batch instead of dozens of eager primitives).
-        BuiltSide is a pytree argument, so all partitions share the
-        compile."""
-        if getattr(self, "_probe_jit", None) is None:
+        """Jitted probe step from the process-global cache: fingerprint
+        search + expansion + gathers fused into a single device program
+        (one dispatch per probe batch instead of dozens of eager
+        primitives). BuiltSide is a pytree argument, so all partitions —
+        and all execs with the same join shape — share the compile."""
+        from spark_rapids_tpu.ops import kernel_cache as kc
+
+        def build():
+            clone = kc.detached_clone(self)
+
             def step(built, pbatch, out_cap, build_is_right, probe_keys):
                 lo, counts, plive = probe_ranges(built, pbatch,
                                                  list(probe_keys),
                                                  built.null_safe)
-                return self._emit_expanded(
+                return clone._emit_expanded(
                     built, pbatch, lo, counts, plive, out_cap,
                     build_is_right, list(probe_keys))
-            self._probe_jit = jax.jit(
+            return jax.jit(
                 step, static_argnames=("out_cap", "build_is_right",
                                        "probe_keys"))
-        return self._probe_jit
+        return kc.lookup("join-probe", self._join_fp(), build)
 
     def _emit_jit_fn(self):
         """Jitted expansion for the synced (max_run > fast bound) path: the
         ranges were already computed eagerly to size the output, so this
         variant takes them as traced arguments instead of re-hashing the
         probe keys and re-searching the build fingerprints."""
-        if getattr(self, "_emit_jit", None) is None:
+        from spark_rapids_tpu.ops import kernel_cache as kc
+
+        def build():
+            clone = kc.detached_clone(self)
+
             def step(built, pbatch, lo, counts, plive, out_cap,
                      build_is_right, probe_keys):
-                return self._emit_expanded(
+                return clone._emit_expanded(
                     built, pbatch, lo, counts, plive, out_cap,
                     build_is_right, list(probe_keys))
-            self._emit_jit = jax.jit(
+            return jax.jit(
                 step, static_argnames=("out_cap", "build_is_right",
                                        "probe_keys"))
-        return self._emit_jit
+        return kc.lookup("join-emit", self._join_fp(), build)
 
     def _dense_step(self, built: BuiltSide, pbatch: DeviceBatch,
                     probe_keys, build_is_right: bool):
@@ -437,11 +457,12 @@ class _JoinKernelMixin:
         return pairs.with_sel(plive)
 
     def _dense_jit_fn(self):
-        if getattr(self, "_dense_jit", None) is None:
-            self._dense_jit = jax.jit(
-                self._dense_step,
-                static_argnames=("probe_keys", "build_is_right"))
-        return self._dense_jit
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        return kc.lookup(
+            "join-dense", self._join_fp(),
+            lambda: jax.jit(kc.detached_clone(self)._dense_step,
+                            static_argnames=("probe_keys",
+                                             "build_is_right")))
 
     def _device_join_stream(self, ctx, built: BuiltSide, probe_iter,
                             probe_keys, build_is_right: bool):
@@ -812,7 +833,9 @@ class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
             # skipped the broadcast shrink) must compact first or deleted
             # rows would join as live.
             from spark_rapids_tpu.columnar.rowmove import compact_batch
-            build = jax.jit(compact_batch)(build)
+            from spark_rapids_tpu.ops import kernel_cache as kc
+            build = kc.lookup("compact-batch", (),
+                              lambda: jax.jit(compact_batch))(build)
         built = BuiltSide(build, None, build.row_mask(),
                           build.row_mask(), build.num_rows)
         bcap = build.capacity

@@ -113,30 +113,38 @@ def sort_key_passes(col: DeviceColumn, ascending: bool,
     return [null_word] + words
 
 
+def _radix_perm(passes: List[jnp.ndarray], capacity: int,
+                unstable_first: bool = False) -> jnp.ndarray:
+    """Stable LSD radix argsort: the ONE traced implementation every
+    multi-pass sort in this engine shares (full sorts, grouping, per-group
+    string min/max — and through the kernel cache, the fused paths).
+
+    ``passes`` are per-row word arrays, most significant first; the
+    returned permutation orders rows by the lexicographic pass tuple.
+    ``unstable_first`` relaxes tie order on the least-significant pass
+    only (spark.rapids.sql.stableSort.enabled off) — every later pass
+    must stay stable for multi-key correctness."""
+    perm = jnp.arange(capacity, dtype=jnp.int32)
+    first = True
+    for words in reversed(passes):
+        keyed = jnp.take(words, perm, axis=0)
+        order = jnp.argsort(keyed, stable=not (unstable_first and first))
+        perm = jnp.take(perm, order, axis=0)
+        first = False
+    return perm
+
+
 def lex_sort_perm(passes: List[jnp.ndarray], live: jnp.ndarray,
                   capacity: int, stable: bool = True) -> jnp.ndarray:
     """Permutation sorting rows by the MSW-first word passes; dead rows
     (padding / deselected) always sort last. ``live`` is either a
-    (capacity,) bool mask (row_mask) or an int32 row-count scalar.
-
-    ``stable=False`` (spark.rapids.sql.stableSort.enabled off) relaxes
-    tie order on the least-significant pass only — every later LSD radix
-    pass must stay stable for multi-key correctness."""
+    (capacity,) bool mask (row_mask) or an int32 row-count scalar."""
     if getattr(live, "ndim", 0) == 0 or np.isscalar(live):
         live = jnp.arange(capacity, dtype=jnp.int32) < live
+    # Padding pass first (most significant of all): dead rows sort last.
     pad_last = jnp.where(live, jnp.uint32(0), jnp.uint32(0xFFFFFFFF))
-    perm = jnp.arange(capacity, dtype=jnp.int32)
-    # LSD radix over words: apply stable argsort from least significant pass
-    # to most significant; padding pass last (most significant of all).
-    first = True
-    for words in reversed(passes):
-        keyed = jnp.take(words, perm, axis=0)
-        order = jnp.argsort(keyed, stable=stable or not first)
-        perm = jnp.take(perm, order, axis=0)
-        first = False
-    keyed = jnp.take(pad_last, perm, axis=0)
-    order = jnp.argsort(keyed, stable=True)
-    return jnp.take(perm, order, axis=0)
+    return _radix_perm([pad_last] + list(passes), capacity,
+                       unstable_first=not stable)
 
 
 # ---------------------------------------------------------------------------
@@ -202,11 +210,7 @@ def group_ids(batch: DeviceBatch, key_ordinals: Sequence[int]) -> Grouping:
     live = batch.row_mask()
     # Sort rows by (live desc, ha, hb): padding last.
     passes = [jnp.where(live, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), ha, hb]
-    perm = jnp.arange(cap, dtype=jnp.int32)
-    for words in reversed(passes):
-        keyed = jnp.take(words, perm, axis=0)
-        order = jnp.argsort(keyed, stable=True)
-        perm = jnp.take(perm, order, axis=0)
+    perm = _radix_perm(passes, cap)
     sa = jnp.take(ha, perm, axis=0)
     sb = jnp.take(hb, perm, axis=0)
     slive = jnp.take(live, perm, axis=0)
@@ -304,11 +308,7 @@ def segment_minmax_string(data: jnp.ndarray, lengths: jnp.ndarray,
     words = [jnp.where(validity, w, jnp.uint32(0)) for w in words]
     lenword = jnp.where(validity, lenword, jnp.uint32(0))
     passes = [gid.astype(jnp.uint32), loser] + words + [lenword]
-    perm = jnp.arange(capacity, dtype=jnp.int32)
-    for w in reversed(passes):
-        keyed = jnp.take(w, perm, axis=0)
-        order = jnp.argsort(keyed, stable=True)
-        perm = jnp.take(perm, order, axis=0)
+    perm = _radix_perm(passes, capacity)
     sorted_gid = jnp.take(gid, perm, axis=0)
     prev = jnp.concatenate([sorted_gid[:1] ^ 1, sorted_gid[:-1]])
     new_seg = sorted_gid != prev

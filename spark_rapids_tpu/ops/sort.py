@@ -162,7 +162,6 @@ class SortExec(Exec):
     def __init__(self, child: Exec, orders: Sequence[SortOrder]):
         super().__init__(child)
         self.orders = list(orders)
-        self._jit = None
 
     @property
     def schema(self) -> Schema:
@@ -170,12 +169,22 @@ class SortExec(Exec):
 
     def _sort_fn(self, ctx):
         from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.ops import kernel_cache as kc
         stable = bool(ctx.conf.get(C.STABLE_SORT))
-        if self._jit is None and all(o.child.jittable for o in self.orders):
-            self._jit = jax.jit(
-                lambda b: sort_batch(b, self.orders, stable=stable))
-        return self._jit or (lambda b: sort_batch(b, self.orders,
-                                                  stable=stable))
+        orders = list(self.orders)
+        if not all(o.child.jittable for o in orders):
+            return lambda b: sort_batch(b, orders, stable=stable)
+        m = ctx.metrics_for(self)
+        fp = kc.fingerprint(tuple(orders))
+        schema_fp = kc.schema_fingerprint(self.schema)
+
+        def fn(b: DeviceBatch) -> DeviceBatch:
+            entry = kc.lookup(
+                "sort", (fp, stable, schema_fp, b.capacity),
+                lambda: jax.jit(
+                    lambda bb: sort_batch(bb, orders, stable=stable)), m)
+            return kc.call(entry, m, b)
+        return fn
 
     def execute_device(self, ctx, partition):
         yield from out_of_core_partition(

@@ -488,7 +488,6 @@ class WindowExec(Exec):
     def __init__(self, child: Exec, exprs: Sequence[WindowExprSpec]):
         super().__init__(child)
         self.exprs = list(exprs)
-        self._jit = None
 
     @property
     def schema(self) -> Schema:
@@ -497,10 +496,20 @@ class WindowExec(Exec):
             base.append((wx.name, wx.fn.result_type()))
         return tuple(base)
 
-    def _window_fn(self):
-        if self._jit is None:
-            self._jit = jax.jit(lambda b: compute_window(b, self.exprs))
-        return self._jit
+    def _window_fn(self, ctx):
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        m = ctx.metrics_for(self)
+        exprs = list(self.exprs)
+        fp = kc.fingerprint(tuple(exprs))
+        schema_fp = kc.schema_fingerprint(self.children[0].schema)
+
+        def fn(b):
+            entry = kc.lookup(
+                "window", (fp, schema_fp, b.capacity),
+                lambda: jax.jit(
+                    lambda bb: compute_window(bb, exprs)), m)
+            return kc.call(entry, m, b)
+        return fn
 
     def execute_device(self, ctx, partition):
         from spark_rapids_tpu.ops.sort import out_of_core_partition
@@ -512,7 +521,7 @@ class WindowExec(Exec):
         yield from out_of_core_partition(
             ctx, ctx.metrics_for(self),
             self.children[0].execute_device(ctx, partition),
-            self.children[0].schema, orders, self._window_fn())
+            self.children[0].schema, orders, self._window_fn(ctx))
 
     # -- host oracle ---------------------------------------------------------
     def execute_host(self, ctx, partition):

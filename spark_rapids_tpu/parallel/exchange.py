@@ -65,7 +65,6 @@ class ShuffleExchangeExec(Exec):
         super().__init__(child)
         self.partitioning = partitioning
         self.allow_coalesce = allow_coalesce
-        self._split_jit = None
 
     @property
     def schema(self) -> Schema:
@@ -167,55 +166,67 @@ class ShuffleExchangeExec(Exec):
         p.bounds = RangePartitioning.compute_bounds(
             merged, bound_orders, p.num_partitions)
 
-    def _pids_counts_fn(self):
-        """Jitted (pids, per-partition live counts) for one child batch."""
-        if getattr(self, "_pids_jit", None) is None:
-            n = self.partitioning.num_partitions
+    def _partitioning_fp(self):
+        """Structural cache key for this exchange's partitioning. Range
+        partitionings fold their sampled bounds in — bounds are DATA, so
+        two queries share a split kernel only when their bounds match."""
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        fp = getattr(self, "_part_fp", None)
+        if fp is None:
+            fp = self._part_fp = kc.fingerprint(self.partitioning)
+        return fp
 
-            def fn(b: DeviceBatch):
-                pids = self.partitioning.partition_ids(b)
-                live = b.row_mask()
-                key = jnp.where(live, pids, n)
-                counts = jax.ops.segment_sum(
-                    jnp.ones((b.capacity,), jnp.int32), key,
-                    num_segments=n + 1)[:n]
-                return pids, counts
-            self._pids_jit = jax.jit(fn) \
-                if self.partitioning.jittable else fn
-        return self._pids_jit
+    def _pids_counts_fn(self, metrics=None):
+        """Jitted (pids, per-partition live counts) for one child batch,
+        from the process-global kernel cache."""
+        partitioning = self.partitioning
+        n = partitioning.num_partitions
 
-    def _split_fn(self, piece_cap: int):
+        def fn(b: DeviceBatch):
+            pids = partitioning.partition_ids(b)
+            live = b.row_mask()
+            key = jnp.where(live, pids, n)
+            counts = jax.ops.segment_sum(
+                jnp.ones((b.capacity,), jnp.int32), key,
+                num_segments=n + 1)[:n]
+            return pids, counts
+        if not partitioning.jittable:
+            return fn
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        return kc.lookup("exchange-pids", (self._partitioning_fp(),),
+                         lambda: jax.jit(fn), metrics)
+
+    def _split_fn(self, piece_cap: int, metrics=None):
         """Jitted split: ONE pid-stable sort + ONE packed gather, then a
         dynamic slice per piece — replaces the per-partition compaction
         storm (contiguousSplit done the TPU way: gather/scatter cost on
         this chip scales with row-operations, so moving all columns once
         beats moving each partition separately ~n-fold)."""
-        key = ("split", piece_cap)
-        fn = self._JITS.get(key) if hasattr(self, "_JITS") else None
-        if not hasattr(self, "_JITS"):
-            self._JITS = {}
-        if fn is None:
-            n = self.partitioning.num_partitions
+        partitioning = self.partitioning
+        n = partitioning.num_partitions
 
-            def fn(b: DeviceBatch, pids, offsets, counts):
-                from spark_rapids_tpu.columnar.rowmove import gather_rows
-                live = b.row_mask()
-                skey = jnp.where(live, pids, n)
-                perm = jnp.argsort(skey, stable=True)
-                # Pad the gather so a slice at offset near the end never
-                # clamps (dynamic_slice adjusts out-of-range starts).
-                idx = jnp.concatenate(
-                    [perm.astype(jnp.int32),
-                     jnp.zeros((piece_cap,), jnp.int32)])
-                sorted_b = gather_rows(b, idx, b.live_count())
-                pieces = []
-                for p in range(n):
-                    pieces.append(_slice_rows(sorted_b, offsets[p],
-                                              piece_cap, counts[p]))
-                return pieces
-            fn = jax.jit(fn) if self.partitioning.jittable else fn
-            self._JITS[key] = fn
-        return fn
+        def fn(b: DeviceBatch, pids, offsets, counts):
+            from spark_rapids_tpu.columnar.rowmove import gather_rows
+            live = b.row_mask()
+            skey = jnp.where(live, pids, n)
+            perm = jnp.argsort(skey, stable=True)
+            # Pad the gather so a slice at offset near the end never
+            # clamps (dynamic_slice adjusts out-of-range starts).
+            idx = jnp.concatenate(
+                [perm.astype(jnp.int32),
+                 jnp.zeros((piece_cap,), jnp.int32)])
+            sorted_b = gather_rows(b, idx, b.live_count())
+            pieces = []
+            for p in range(n):
+                pieces.append(_slice_rows(sorted_b, offsets[p],
+                                          piece_cap, counts[p]))
+            return pieces
+        if not partitioning.jittable:
+            return fn
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        return kc.lookup("exchange-split",
+                         (self._partitioning_fp(), piece_cap),
+                         lambda: jax.jit(fn), metrics)
 
     def _materialize_device(self, ctx) -> List[List[DeviceBatch]]:
         key = self._cache_key(True)
@@ -228,7 +239,7 @@ class ShuffleExchangeExec(Exec):
         from spark_rapids_tpu.columnar.batch import shrink_to_capacity
         from spark_rapids_tpu.memory.stores import (
             PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
-        pids_fn = self._pids_counts_fn()
+        pids_fn = self._pids_counts_fn(metrics=ctx.metrics_for(self))
         # Two-phase sizes-then-data (SURVEY §7): dispatch per-batch
         # partition-id counts, pull the whole window's counts in ONE
         # batched device_get (a sync is a full network round trip on a
@@ -268,7 +279,8 @@ class ShuffleExchangeExec(Exec):
                 piece_cap = bucket_capacity(max(max(counts), 1))
                 offsets = np.concatenate(
                     [[0], np.cumsum(counts[:-1])]).astype(np.int32)
-                pieces = self._split_fn(piece_cap)(
+                pieces = self._split_fn(
+                    piece_cap, metrics=ctx.metrics_for(self))(
                     batch, pids, jnp.asarray(offsets),
                     jnp.asarray(counts, jnp.int32))
                 for p, piece in enumerate(pieces):

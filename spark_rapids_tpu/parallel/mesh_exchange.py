@@ -139,8 +139,19 @@ class MeshExchangeExec(Exec):
     def __init__(self, child: Exec, partitioning: Partitioning):
         super().__init__(child)
         self.partitioning = partitioning
-        self._steps = {}        # piece_capacity -> jitted collective
-        self._counts_jit = None
+
+    def _mesh_key(self, mesh):
+        """Cache key part identifying this exchange's collective shape:
+        the partitioning structure + the mesh's device layout. Collective
+        programs from the process-global kernel cache are shared across
+        exec instances (every fresh query otherwise re-traces the
+        shard_map programs)."""
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        fp = getattr(self, "_part_fp", None)
+        if fp is None:
+            fp = self._part_fp = kc.fingerprint(self.partitioning)
+        devs = tuple(int(d.id) for d in mesh.devices.flat)
+        return (fp, tuple(mesh.axis_names), devs)
 
     @property
     def schema(self) -> Schema:
@@ -207,21 +218,24 @@ class MeshExchangeExec(Exec):
             # the default padding is an n-fold wire inflation at scale.
             # n == 1 skips the phase: the collective moves nothing, so
             # the counts sync could only cost.
-            if getattr(self, "_pids_jit", None) is None:
-                self._pids_jit = self._pids_step(mesh)
-            pids = self._pids_jit(stacked)
+            from spark_rapids_tpu.ops import kernel_cache as kc
+            mkey = self._mesh_key(mesh)
+            pids_fn = kc.lookup("mesh-pids", mkey,
+                                lambda: self._pids_step(mesh), m)
+            pids = pids_fn(stacked)
             piece_cap = None
             if n > 1 and shards[0].capacity >= TWO_PHASE_MIN_SHARD_ROWS:
-                if self._counts_jit is None:
-                    self._counts_jit = self._counts_step(mesh, n)
-                counts = np.asarray(self._counts_jit(stacked, pids))
+                counts_fn = kc.lookup(
+                    "mesh-counts", mkey,
+                    lambda: self._counts_step(mesh, n), m)
+                counts = np.asarray(counts_fn(stacked, pids))
                 piece_cap = bucket_capacity(max(int(counts.max()), 1))
                 if piece_cap >= shards[0].capacity:
                     piece_cap = None    # padding wouldn't shrink anything
-            step = self._steps.get(piece_cap)
-            if step is None:
-                step = self._build_step(mesh, n, piece_capacity=piece_cap)
-                self._steps[piece_cap] = step
+            step = kc.lookup(
+                "mesh-exchange", mkey + (piece_cap,),
+                lambda: self._build_step(mesh, n,
+                                         piece_capacity=piece_cap), m)
             out = step(stacked, pids)
             parts = _addressable_parts(out, n)
         ctx.cache[key] = parts

@@ -415,6 +415,16 @@ class PhysicalPlan:
     def explain(self, mode: str = "ALL") -> str:
         lines = self.meta.explain_lines(
             not_on_device_only=(mode.upper() == "NOT_ON_GPU"))
+        from spark_rapids_tpu.plan.fusion import collect_fused
+        fused = collect_fused(self.root)
+        if fused:
+            # Render fused stages with their member operator names so the
+            # physical shape (and each stage's metrics owner) stays
+            # readable next to the logical fallback report.
+            lines.append(f"Fused stages: {len(fused)}")
+            for i, f in enumerate(fused):
+                members = ", ".join(type(o).__name__ for o in f.ops)
+                lines.append(f"  *Stage #{i} <{f.name}> fuses [{members}]")
         return "\n".join(lines)
 
     def collect(self, ctx=None):
@@ -475,7 +485,18 @@ class Planner:
             print("\n".join(meta.explain_lines(
                 not_on_device_only=self.conf.explain == "NOT_ON_GPU")))
         root, side = self._convert(meta)
+        # Process-global kernel cache: size it from this query's conf
+        # (last writer wins — it is one process-wide pool, like the
+        # reference's single RMM pool).
+        from spark_rapids_tpu.ops import kernel_cache
+        kernel_cache.cache().configure(
+            int(self.conf.get(C.KERNEL_CACHE_MAX_ENTRIES)))
+        num_fused = 0
+        if bool(self.conf.get(C.STAGE_FUSION_ENABLED)):
+            from spark_rapids_tpu.plan.fusion import fuse_stages
+            root, num_fused = fuse_stages(root, side)
         phys = PhysicalPlan(root, side, meta, self.conf)
+        phys.num_fused_stages = num_fused
         if self.conf.test_enabled:
             allowed = {s for s in str(self.conf.get(
                 C.TEST_ALLOWED_NONTPU)).split(",") if s}

@@ -27,6 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Acceptance hook: SRT_STAGE_FUSION=0 flips the stage-fusion default off
+# for a whole test run, verifying every suite still passes with the
+# unfused plan shape (spark.rapids.sql.stageFusion.enabled=false).
+if os.environ.get("SRT_STAGE_FUSION") == "0":
+    from spark_rapids_tpu import config as _C  # noqa: E402
+    _C.STAGE_FUSION_ENABLED.default = False
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,199 @@
+"""Whole-stage fusion + process-global kernel cache (the serving story:
+repeated execution pays compile cost exactly once).
+
+Covers: the Project->Filter->Project chain compiling as ONE fused kernel,
+the retrace-regression guarantee (a repeated TPC-H query through a FRESH
+planner reports zero kernel-cache misses), the stageFusion.enabled kill
+switch restoring the unfused plan shape, stage breaks at non-fusible
+operators, LocalLimit budget threading inside a fused stage, and the
+explain/pretty_tree/metrics rendering of fused stages."""
+
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.ops import kernel_cache as kc
+from spark_rapids_tpu.ops.fused import FusedStageExec
+from spark_rapids_tpu.plan.logical import agg_sum, col
+
+
+def _chain_df(s: TpuSession):
+    df = s.create_dataframe(
+        {"k": [1, 2, 3, 4, 5, 6], "v": [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]},
+        [("k", srt.INT64), ("v", srt.FLOAT64)], num_partitions=2)
+    return df.select((col("v") * 2).alias("v2"), "k") \
+             .filter(col("v2") > 2.0) \
+             .select((col("v2") + 1).alias("v3"), "k")
+
+
+def _find(node, cls):
+    out = []
+
+    def rec(n):
+        if isinstance(n, cls):
+            out.append(n)
+        for c in n.children:
+            rec(c)
+    rec(node)
+    return out
+
+
+class TestFusionShape:
+    def test_project_filter_project_fuses_to_one_stage(self):
+        q = _chain_df(TpuSession())
+        phys = q._physical()
+        fused = _find(phys.root, FusedStageExec)
+        assert len(fused) == 1
+        assert len(fused[0].ops) == 3
+        names = [type(o).__name__ for o in fused[0].ops]
+        assert sorted(names) == ["FilterExec", "ProjectExec",
+                                 "ProjectExec"]
+        # No standalone Project/Filter execs remain in the device plan.
+        from spark_rapids_tpu.ops.basic import FilterExec, ProjectExec
+        assert not _find(phys.root, ProjectExec)
+        assert not _find(phys.root, FilterExec)
+
+    def test_chain_compiles_as_single_kernel(self):
+        """A fusible 3-op chain executes as ONE jitted kernel — the cache
+        sees exactly one fused-stage program and zero per-op project or
+        filter programs."""
+        kc.cache().clear()
+        q = _chain_df(TpuSession())
+        got = sorted(q.collect())
+        assert got == sorted(q.collect_host())
+        kinds = {k[0] for k in kc.cache().keys()}
+        assert "fused-stage" in kinds
+        assert "project" not in kinds and "filter" not in kinds
+        fused_keys = [k for k in kc.cache().keys()
+                      if k[0] == "fused-stage"]
+        assert len(fused_keys) == 1
+
+    def test_gate_off_restores_unfused_plan(self):
+        from spark_rapids_tpu.ops.basic import FilterExec, ProjectExec
+        s = TpuSession()
+        s.set("spark.rapids.sql.stageFusion.enabled", False)
+        q = _chain_df(s)
+        phys = q._physical()
+        assert not _find(phys.root, FusedStageExec)
+        assert _find(phys.root, ProjectExec)
+        assert _find(phys.root, FilterExec)
+        assert sorted(q.collect()) == sorted(q.collect_host())
+
+    def test_stage_breaks_at_aggregate(self):
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        df = s.create_dataframe(
+            {"k": [1, 1, 2, 2], "v": [1.0, 2.0, 3.0, 4.0]},
+            [("k", srt.INT64), ("v", srt.FLOAT64)])
+        # filter -> project below the agg; project above it: two fusible
+        # regions separated by the aggregate, neither long enough alone
+        # except the lower one (2 ops).
+        q = df.filter(col("v") > 1.0) \
+              .select("k", (col("v") * 10).alias("w")) \
+              .group_by("k").agg(agg_sum(col("w")).alias("sw"))
+        phys = q._physical()
+        fused = _find(phys.root, FusedStageExec)
+        assert len(fused) == 1          # the filter+project pair
+        assert len(fused[0].ops) == 2
+        got = dict(q.collect())
+        assert got == {1: 20.0, 2: 70.0}
+
+    def test_contextual_exprs_do_not_fuse(self):
+        from spark_rapids_tpu.plan.logical import spark_partition_id
+        s = TpuSession()
+        df = s.create_dataframe(
+            {"v": [1.0, 2.0, 3.0]}, [("v", srt.FLOAT64)])
+        q = df.select((col("v") * 2).alias("v2")) \
+              .with_column("p", spark_partition_id())
+        phys = q._physical()
+        # The contextual projection stays unfused (needs EvalContext).
+        for f in _find(phys.root, FusedStageExec):
+            for op in f.ops:
+                from spark_rapids_tpu.exprs.nondeterministic import \
+                    needs_eval_context
+                assert not needs_eval_context(getattr(op, "exprs", []))
+        assert sorted(q.collect()) == [(2.0, 0), (4.0, 0), (6.0, 0)]
+
+    def test_local_limit_budget_threads_through_fusion(self):
+        """LocalLimit inside a fused stage keeps its per-partition budget
+        across batches (traced carry, no host sync)."""
+        s = TpuSession()
+        df = s.create_dataframe(
+            {"v": list(range(20))}, [("v", srt.INT64)])
+        q = df.select((col("v") * 1).alias("v")) \
+              .filter(col("v") >= 0).limit(5)
+        assert len(q.collect()) == 5
+
+
+class TestRetraceRegression:
+    @pytest.fixture(scope="class")
+    def tpch_dir(self, tmp_path_factory):
+        from spark_rapids_tpu.benchmarks import tpch
+        d = str(tmp_path_factory.mktemp("tpch_fusion"))
+        tpch.generate(d, scale=0.002, files_per_table=2, seed=11)
+        return d
+
+    def _session(self):
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        return s
+
+    @pytest.mark.parametrize("qname", ["q6", "q1"])
+    def test_second_run_has_zero_cache_misses(self, qname, tpch_dir):
+        """The retrace-regression guarantee: running the SAME TPC-H query
+        twice through a fresh planner/session compiles nothing on the
+        second run — every kernel lookup hits the process-global cache."""
+        from spark_rapids_tpu.benchmarks import tpch
+        first = tpch.QUERIES[qname](self._session(), tpch_dir).collect()
+        kc.cache().reset_stats()
+        second = tpch.QUERIES[qname](self._session(), tpch_dir).collect()
+        stats = kc.cache().stats()
+        assert stats["misses"] == 0, (
+            f"{qname} second run re-traced kernels: {stats}; "
+            f"keys={kc.cache().keys()}")
+        assert stats["hits"] > 0
+        assert tpch.rows_close(sorted(first), sorted(second))
+
+
+class TestObservability:
+    def test_explain_and_pretty_tree_render_fused_stage(self):
+        q = _chain_df(TpuSession())
+        phys = q._physical()
+        tree = phys.root.pretty_tree()
+        assert "FusedStageExec[ProjectExec->FilterExec->ProjectExec]" \
+            in tree
+        report = phys.explain()
+        assert "Fused stages: 1" in report
+        assert "fuses [ProjectExec, FilterExec, ProjectExec]" in report
+
+    def test_fused_metrics_owner_and_cache_counters(self):
+        q = _chain_df(TpuSession())
+        q.collect()
+        m = q.metrics()
+        fused_key = next(k for k in m if k.startswith("FusedStageExec["))
+        vals = m[fused_key]
+        assert vals.get("numFusedStages") == 1
+        assert vals.get("numFusedOps") == 3
+        assert vals.get("numOutputBatches", 0) >= 1
+        hits = vals.get("kernelCacheHits", 0)
+        misses = vals.get("kernelCacheMisses", 0)
+        assert hits + misses >= 1
+        if misses:     # a fresh compile surfaces its compile time
+            assert vals.get("compileTime", 0) > 0
+
+    def test_cache_lru_bound_evicts(self):
+        cache = kc.KernelCache(max_entries=2)
+        for i in range(4):
+            cache.get(("k", i), lambda: i)
+        st = cache.stats()
+        assert st["entries"] == 2 and st["evictions"] == 2
+
+    def test_kernel_cache_max_entries_conf(self):
+        s = TpuSession()
+        s.set("spark.rapids.sql.kernelCache.maxEntries", 7)
+        _chain_df(s)._physical()
+        assert kc.cache().max_entries == 7
+        # Restore the default for the rest of the suite.
+        s2 = TpuSession()
+        _chain_df(s2)._physical()
+        assert kc.cache().max_entries == 1024
