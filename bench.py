@@ -160,6 +160,7 @@ def main():
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
 
+    from spark_rapids_tpu import faults as _faults
     from spark_rapids_tpu.benchmarks import suites, tpch
     from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
     from spark_rapids_tpu.ops import kernel_cache as _kc
@@ -213,6 +214,11 @@ def main():
         "kernel_cache": {}, "kernel_cache_per_query": cache_q,
         "completed": [], "timed_out": False, "partial": True,
         "rows": rows, "datagen_s": round(gen_s, 2),
+        # Recovery machinery counters (memory/oom.py ladder, planner
+        # transient retry, host degradation, fault injection): all zero
+        # on a healthy run — nonzero values say the run survived real
+        # pressure (or an SRT_FAULTS chaos schedule).
+        "recovery": {},
     }
     with _LOCK:
         _STATE["out"] = out
@@ -266,6 +272,7 @@ def main():
                 "hits": kc1["hits"] - kc0["hits"],
                 "misses": kc1["misses"] - kc0["misses"]}
             out["kernel_cache"] = kc1
+            out["recovery"] = _faults.counters()
             out["completed"].append(qn)
             done = out["completed"]
             out["metric"] = f"tpc_sf{sf:g}_suite{len(done)}_wall_clock"
@@ -293,6 +300,12 @@ def main():
         DEVICE_SCAN_CACHE.clear()
 
     with _LOCK:
+        rec = _faults.counters()
+        for name in ("faultsInjected", "retriesAttempted",
+                     "spillEscalations", "hostFallbacks",
+                     "corruptionsDetected"):
+            rec.setdefault(name, 0)
+        out["recovery"] = rec
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

@@ -409,7 +409,8 @@ class DataFrame:
                 try:
                     batches = []
                     for p in range(root.num_partitions(ctx)):
-                        batches.extend(root.execute_device(ctx, p))
+                        batches.extend(
+                            root.execute_device_recovering(ctx, p))
                     if not batches:
                         return self._empty_jax(root.schema)
                     single = batches[0] if len(batches) == 1 else \

@@ -288,8 +288,14 @@ def jit_concat_batches(batches: Sequence[DeviceBatch],
     fn = _kernel_lookup("concat", (capacity,),
                         lambda: jax.jit(
                             lambda bs: concat_batches(bs, capacity)))
+    from spark_rapids_tpu import faults
     from spark_rapids_tpu.memory.oom import retry_on_oom
-    return retry_on_oom(fn, list(batches))
+
+    def dispatch(bs):
+        faults.fault_point("concat")
+        return fn(bs)
+
+    return retry_on_oom(dispatch, list(batches))
 
 
 # Below this device size a shrink/compaction cannot repay its sizes-pull

@@ -256,7 +256,16 @@ def download_batches(batches: Sequence[DeviceBatch],
             leaves.append(c.validity)
             if c.dtype.is_string:
                 leaves.append(c.lengths)
-    fetched = jax.device_get([x for x in leaves if x is not None])
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.memory.oom import retry_on_oom
+
+    def _fetch():
+        # Named injection site + OOM ladder around the one batched
+        # device_get every result takes (the download dispatch funnel).
+        faults.fault_point("download")
+        return jax.device_get([x for x in leaves if x is not None])
+
+    fetched = retry_on_oom(_fetch)
     it = iter(fetched)
     out = []
     for b in batches:

@@ -38,14 +38,61 @@ transfers pipeline instead of paying one round trip per buffer.
 
 from __future__ import annotations
 
+import struct
+import zlib
 from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
+
+
+# ---------------------------------------------------------------------------
+# Integrity framing for serialized batch blobs (spill frames, any future
+# inter-process shuffle wire). A 16-byte header: magic | CRC32 | length.
+# Deserialize verifies ALL THREE, so a flipped bit / truncated write /
+# foreign blob raises WireCorruptionError at the frame boundary instead
+# of np.frombuffer silently reinterpreting garbage into wrong rows.
+# ---------------------------------------------------------------------------
+
+_FRAME_MAGIC = b"SRTW"
+_FRAME_HEADER = struct.Struct("<4sIQ")      # magic, crc32, payload length
+
+
+class WireCorruptionError(ValueError):
+    """A serialized frame failed its integrity check at deserialize."""
+
+
+def frame_blob(blob: bytes) -> bytes:
+    """Wrap ``blob`` in the checksummed wire frame."""
+    return _FRAME_HEADER.pack(_FRAME_MAGIC, zlib.crc32(blob) & 0xFFFFFFFF,
+                              len(blob)) + blob
+
+
+def unframe_blob(framed: bytes) -> bytes:
+    """Verify + strip the wire frame; raises :class:`WireCorruptionError`
+    on any mismatch (magic, length, or CRC32)."""
+    if len(framed) < _FRAME_HEADER.size:
+        raise WireCorruptionError(
+            f"frame truncated: {len(framed)} bytes < header")
+    magic, crc, length = _FRAME_HEADER.unpack_from(framed)
+    if magic != _FRAME_MAGIC:
+        raise WireCorruptionError(f"bad frame magic {magic!r}")
+    payload = framed[_FRAME_HEADER.size:]
+    if len(payload) != length:
+        raise WireCorruptionError(
+            f"frame length mismatch: header says {length}, "
+            f"payload is {len(payload)}")
+    actual = zlib.crc32(payload) & 0xFFFFFFFF
+    if actual != crc:
+        raise WireCorruptionError(
+            f"frame CRC32 mismatch: header {crc:#010x}, "
+            f"payload {actual:#010x}")
+    return payload
 
 # Column wire spec (static, hashable -- part of the decode jit cache key):
 #   numeric: ("num", logical_name, wire_np_name, vmode)
@@ -366,6 +413,10 @@ def upload_encoded(arrays, specs, n: int, cap: int) -> DeviceBatch:
     from spark_rapids_tpu.memory.oom import retry_on_oom
 
     def put_and_decode():
+        # Injection site INSIDE the retried dispatch: an injected OOM
+        # here exercises the same escalation ladder a real allocation
+        # failure would (tests/test_chaos.py).
+        faults.fault_point("upload")
         put = jax.device_put(arrays)
         dev_arrays, num_rows = put[:-1], put[-1]
         key = (cap, specs)

@@ -368,6 +368,44 @@ DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
     "from allocFraction of the visible device memory (ref: RMM pool "
     "sizing, GpuDeviceManager.scala:159-230).").long(0)
 
+TEST_FAULTS = conf("spark.rapids.sql.test.faults").doc(
+    "Deterministic fault-injection schedule for chaos testing: "
+    "comma-separated kind@site[:arg] entries (kinds oom/transient/"
+    "corrupt; arg = fire-count or probability), e.g. "
+    "'oom@upload:0.05,transient@exchange.flush:2,corrupt@wire:1'. "
+    "Empty disarms. The SRT_FAULTS env var seeds the process-global "
+    "schedule when this key is unset. See docs/robustness.md and "
+    "spark_rapids_tpu/faults.py.").string("")
+
+TEST_FAULTS_SEED = conf("spark.rapids.sql.test.faults.seed").doc(
+    "Seed for the per-site fault-injection PRNGs and retry-backoff "
+    "jitter: the same schedule + seed reproduces the same failures AND "
+    "the same recovery timing (SRT_FAULTS_SEED env analog).").long(0)
+
+RETRY_TRANSIENT_MAX = conf(
+    "spark.rapids.sql.retry.transientMaxRetries").doc(
+    "Per-query retry budget for transient backend/tunnel failures "
+    "(UNAVAILABLE, DEADLINE_EXCEEDED, connection resets): the whole "
+    "query re-runs on a fresh context up to this many times, with "
+    "exponential backoff between attempts. 0 disables the retry."
+).integer(2)
+
+RETRY_BACKOFF_MS = conf("spark.rapids.sql.retry.backoffMs").doc(
+    "Base backoff before transient-retry attempt i: "
+    "min(backoffMs * 2^i, maxBackoffMs) scaled by deterministic jitter "
+    "in [0.5, 1.0) seeded from spark.rapids.sql.test.faults.seed."
+).long(50)
+
+RETRY_MAX_BACKOFF_MS = conf("spark.rapids.sql.retry.maxBackoffMs").doc(
+    "Ceiling on the exponential transient-retry backoff.").long(2000)
+
+OOM_HOST_FALLBACK = conf("spark.rapids.sql.oom.hostFallback.enabled").doc(
+    "Final OOM escalation rung: when a device operator exhausts the "
+    "spill-some -> spill-all -> shrink ladder before producing its "
+    "first batch, re-run that operator subtree on the host engine and "
+    "upload the results (the reference's CPU-fallback-always-available "
+    "guarantee applied at the dispatch funnel).").boolean(True)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
@@ -474,6 +512,25 @@ def generate_docs() -> str:
         "`kernelCacheHits`/`kernelCacheMisses`/`compileTime` metrics and",
         "fused stages are rendered in `explain`/`pretty_tree` output with",
         "their member operator names.",
+        "",
+        "## Robustness: fault injection & the recovery ladder",
+        "",
+        "Device OOMs at any dispatch funnel (upload, concat, cached",
+        "kernel, download) walk a bounded escalation ladder instead of",
+        "failing: spill-some -> spill-all -> shrink the batch target ->",
+        "degrade the operator subtree to the host engine",
+        "(`spark.rapids.sql.oom.hostFallback.enabled`). Transient",
+        "backend/tunnel errors retry the whole query on a fresh context",
+        "with exponential backoff and deterministic jitter, bounded by",
+        "`spark.rapids.sql.retry.transientMaxRetries`. Spilled frames",
+        "carry a CRC32 checksum verified at deserialize, so corruption",
+        "is detected (and re-read once) instead of decoding into wrong",
+        "rows. The whole machinery is continuously exercised by",
+        "deterministic fault injection (`spark.rapids.sql.test.faults` /",
+        "`SRT_FAULTS`) — see docs/robustness.md and tests/test_chaos.py.",
+        "Recovery counters (retriesAttempted, spillEscalations,",
+        "hostFallbacks, faultsInjected, corruptionsDetected) surface",
+        "through `DataFrame.metrics()` and bench.py's JSON report.",
         "",
         "## Dynamic per-rule kill switches",
         "",

@@ -1,0 +1,288 @@
+"""Deterministic fault-injection registry (the chaos half of the
+robustness story).
+
+The reference's headline guarantee is that nothing failing ever corrupts
+a query: RMM's alloc-failure callback spills and retries
+(DeviceMemoryEventHandler.scala:42-69) and CPU fallback is always
+available. This engine has the same machinery (memory/oom.py ladder,
+planner transient retry, host degradation) — but recovery code that is
+never exercised is recovery code that cannot be trusted. This module
+makes every dispatch funnel *injectable* so tests/test_chaos.py can run
+real queries under seeded fault schedules and assert bit-identical
+results.
+
+Spec grammar (``spark.rapids.sql.test.faults`` config or ``SRT_FAULTS``
+env)::
+
+    kind@site[:arg][,kind@site[:arg]...]
+
+- ``kind``: ``oom`` (raises a synthetic RESOURCE_EXHAUSTED, recovered by
+  the OOM escalation ladder), ``transient`` (raises a synthetic
+  UNAVAILABLE, recovered by the planner's whole-query retry), or
+  ``corrupt`` (flips one byte of a serialized frame at a corruption
+  site; detected by the CRC32 frame checksum and re-read).
+- ``site``: a named injection point woven into the dispatch funnels:
+  ``upload`` (wire codec device_put), ``download`` (result device_get),
+  ``concat`` (batch coalescing), ``kernel`` (cached-kernel dispatch),
+  ``exchange.flush`` / ``exchange.serve`` (shuffle map/reduce sides),
+  ``mesh.exchange`` (collective shuffle), ``spill.write`` /
+  ``spill.read`` (disk tier I/O), ``wire`` (serialized spill frames —
+  corrupt only).
+- ``arg``: an integer N fires on the first N hits of the site (default
+  1); a float p in (0, 1) fires per-hit with probability p from a
+  deterministic per-site PRNG seeded by
+  ``spark.rapids.sql.test.faults.seed`` / ``SRT_FAULTS_SEED``.
+
+The registry is process-global and ARMED only while a non-empty spec is
+configured; a disarmed ``fault_point`` is a single attribute load, so
+production dispatch pays nothing. Every injection/recovery event bumps
+the process-global counters (``faultsInjected``, ``retriesAttempted``,
+``spillEscalations``, ``hostFallbacks``, ``corruptionsDetected``) and,
+when a query is running, the per-query ``Recovery`` Metrics sink —
+surfaced through ``DataFrame.metrics()`` and ``bench.py``'s JSON.
+
+Deliberately imports nothing beyond stdlib: oom/stores/wire/ops all
+import this module from deep dispatch code.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, List, Optional
+
+
+class InjectedOomError(RuntimeError):
+    """Synthetic device allocation failure. The message carries the
+    backend's RESOURCE_EXHAUSTED marker so ``is_oom_error`` routes it
+    into the spill/retry ladder exactly like the real thing."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"RESOURCE_EXHAUSTED: injected fault at {site!r} "
+            f"(spark.rapids.sql.test.faults)")
+        self.site = site
+
+
+class InjectedTransientError(RuntimeError):
+    """Synthetic backend/tunnel failure. Carries the UNAVAILABLE marker
+    so ``is_transient_error`` routes it into the whole-query retry."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"UNAVAILABLE: injected transient fault at {site!r} "
+            f"(spark.rapids.sql.test.faults)")
+        self.site = site
+
+
+class FaultSpec:
+    """One parsed ``kind@site:arg`` entry."""
+
+    __slots__ = ("kind", "site", "count", "probability", "fired")
+
+    def __init__(self, kind: str, site: str, count: Optional[int],
+                 probability: Optional[float]):
+        self.kind = kind
+        self.site = site
+        self.count = count              # fire on the first N hits
+        self.probability = probability  # or per-hit Bernoulli(p)
+        self.fired = 0
+
+    def __repr__(self):  # pragma: no cover - debug
+        arg = self.probability if self.count is None else self.count
+        return f"FaultSpec({self.kind}@{self.site}:{arg})"
+
+
+_KINDS = ("oom", "transient", "corrupt")
+
+
+class FaultParseError(ValueError):
+    pass
+
+
+def parse_spec(spec: str) -> List[FaultSpec]:
+    out: List[FaultSpec] = []
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "@" not in entry:
+            raise FaultParseError(
+                f"bad fault entry {entry!r}: expected kind@site[:arg]")
+        kind, rest = entry.split("@", 1)
+        kind = kind.strip().lower()
+        if kind not in _KINDS:
+            raise FaultParseError(
+                f"unknown fault kind {kind!r} (want one of {_KINDS})")
+        if ":" in rest:
+            site, arg = rest.rsplit(":", 1)
+        else:
+            site, arg = rest, "1"
+        site = site.strip()
+        if not site:
+            raise FaultParseError(f"bad fault entry {entry!r}: empty site")
+        arg = arg.strip()
+        try:
+            if "." in arg:
+                p = float(arg)
+                if not 0.0 < p <= 1.0:
+                    raise FaultParseError(
+                        f"fault probability out of (0, 1]: {entry!r}")
+                out.append(FaultSpec(kind, site, None, p))
+            else:
+                n = int(arg)
+                if n < 1:
+                    raise FaultParseError(
+                        f"fault count must be >= 1: {entry!r}")
+                out.append(FaultSpec(kind, site, n, None))
+        except ValueError as e:
+            if isinstance(e, FaultParseError):
+                raise
+            raise FaultParseError(f"bad fault arg in {entry!r}") from e
+    return out
+
+
+class FaultInjector:
+    """Armed schedule: per-site hit counters + deterministic PRNGs."""
+
+    def __init__(self, spec: str, seed: int = 0):
+        self.spec = spec
+        self.seed = int(seed)
+        self.entries = parse_spec(spec)
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+
+    def _rng(self, site: str) -> random.Random:
+        rng = self._rngs.get(site)
+        if rng is None:
+            # Seeded per (seed, site): the roll sequence at a site is a
+            # pure function of the schedule, never of thread timing at
+            # OTHER sites.
+            rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
+        return rng
+
+    def should_fire(self, site: str, kinds) -> Optional[FaultSpec]:
+        """One hit of ``site``; returns the spec entry that fires (first
+        match wins) or None. Thread-safe and deterministic for count
+        faults; probability faults are deterministic given a
+        deterministic hit order."""
+        with self._lock:
+            hit = self._hits.get(site, 0) + 1
+            self._hits[site] = hit
+            for e in self.entries:
+                if e.site != site or e.kind not in kinds:
+                    continue
+                if e.count is not None:
+                    if e.fired < e.count:
+                        e.fired += 1
+                        return e
+                elif self._rng(site).random() < e.probability:
+                    e.fired += 1
+                    return e
+        return None
+
+
+_LOCK = threading.Lock()
+_INJECTOR: Optional[FaultInjector] = None
+_COUNTERS: Dict[str, float] = {}
+_TL = threading.local()
+
+
+def _env_injector() -> Optional[FaultInjector]:
+    spec = os.environ.get("SRT_FAULTS", "").strip()
+    if not spec:
+        return None
+    return FaultInjector(spec, int(os.environ.get("SRT_FAULTS_SEED", "0")))
+
+
+with _LOCK:
+    _INJECTOR = _env_injector()
+
+
+def configure(spec: str, seed: int = 0) -> Optional[FaultInjector]:
+    """(Re-)arm the process-global schedule; empty spec disarms. Count
+    faults reset to unfired — callers arm once per query so a retried
+    attempt sees the REMAINING schedule, not a fresh one."""
+    global _INJECTOR
+    with _LOCK:
+        _INJECTOR = FaultInjector(spec, seed) if spec.strip() else None
+        return _INJECTOR
+
+
+def maybe_configure(conf) -> None:
+    """Arm from ``spark.rapids.sql.test.faults`` when the query's conf
+    sets it explicitly (the config wins over SRT_FAULTS); called once
+    per query by PhysicalPlan.collect, BEFORE the attempt loop, so
+    transient retries run against the remaining schedule."""
+    from spark_rapids_tpu import config as C
+    if C.TEST_FAULTS.key in conf.raw:
+        configure(str(conf.get(C.TEST_FAULTS)),
+                  int(conf.get(C.TEST_FAULTS_SEED)))
+
+
+def injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+def set_recovery_sink(metrics) -> None:
+    """Per-query Metrics object that mirrors the process-global recovery
+    counters (set around a collect by ops/base.py)."""
+    _TL.sink = metrics
+
+
+def record(name: str, amount: float = 1) -> None:
+    """Bump a recovery counter: process-global (bench.py JSON) and the
+    active query's Recovery metrics (DataFrame.metrics())."""
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
+    sink = getattr(_TL, "sink", None)
+    if sink is not None:
+        sink.add(name, amount)
+
+
+def counters() -> Dict[str, float]:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+    with _LOCK:
+        _COUNTERS.clear()
+
+
+def fault_point(site: str) -> None:
+    """Named injection site. No-op unless a schedule is armed; raises
+    the synthetic error when an ``oom``/``transient`` entry fires."""
+    inj = _INJECTOR
+    if inj is None:
+        return
+    e = inj.should_fire(site, ("oom", "transient"))
+    if e is None:
+        return
+    record("faultsInjected")
+    record(f"faultsInjected.{e.kind}@{site}")
+    if e.kind == "oom":
+        raise InjectedOomError(site)
+    raise InjectedTransientError(site)
+
+
+def corrupt_blob(site: str, blob: bytes) -> bytes:
+    """Corruption site: returns ``blob`` with one byte flipped when a
+    ``corrupt`` entry fires (deterministic offset from the site PRNG),
+    else the blob unchanged. Used on READ paths so the underlying data
+    survives — detection + one re-read recovers; real (persistent)
+    corruption still fails loudly at the checksum."""
+    inj = _INJECTOR
+    if inj is None or not blob:
+        return blob
+    e = inj.should_fire(site, ("corrupt",))
+    if e is None:
+        return blob
+    record("faultsInjected")
+    record(f"faultsInjected.corrupt@{site}")
+    off = inj._rng(site).randrange(len(blob))
+    out = bytearray(blob)
+    out[off] ^= 0xFF
+    return bytes(out)

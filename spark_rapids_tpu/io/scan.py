@@ -31,6 +31,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import os
+from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -133,8 +134,11 @@ def enumerate_units(fmt: str, paths: Sequence[str]) -> List[ScanUnit]:
 # exposes no ORC column statistics, so the engine builds its own per-
 # stripe min/max/null index on FIRST contact with a stripe (one decode of
 # the predicate columns) and prunes every later scan from the cache.
-# (stripe_key) -> {col: (min, max, null_count, rows)}
-_ORC_STATS_CACHE: Dict[Tuple, Dict[str, tuple]] = {}
+# (stripe_key) -> {col: (min, max, null_count, rows)}. A true LRU:
+# hits move-to-end, and eviction happens only when a genuinely NEW key
+# is inserted at capacity — warm stripes survive a full cache, instead
+# of FIFO-evicting the entries the workload keeps probing.
+_ORC_STATS_CACHE: "OrderedDict[Tuple, Dict[str, tuple]]" = OrderedDict()
 _ORC_STATS_CACHE_MAX = 4096
 
 
@@ -154,6 +158,8 @@ def _orc_stripe_stats(unit: ScanUnit, names: Sequence[str]
     st = os.stat(unit.path)
     key = (unit.path, st.st_mtime, st.st_size, unit.index)
     cached = _ORC_STATS_CACHE.get(key)
+    if cached is not None:
+        _ORC_STATS_CACHE.move_to_end(key)
     need = [n for n in names
             if cached is None or n not in cached]
     if need:
@@ -175,9 +181,13 @@ def _orc_stripe_stats(unit: ScanUnit, names: Sequence[str]
         for n in need:
             if n not in entry:      # absent column: unknown-stats marker
                 entry[n] = (None, None, None, -1)
-        while len(_ORC_STATS_CACHE) >= _ORC_STATS_CACHE_MAX:
-            _ORC_STATS_CACHE.pop(next(iter(_ORC_STATS_CACHE)))
+        if key not in _ORC_STATS_CACHE:
+            # Evict only for a genuinely new key (an update of a resident
+            # key must never push out a warm neighbor), oldest first.
+            while len(_ORC_STATS_CACHE) >= _ORC_STATS_CACHE_MAX:
+                _ORC_STATS_CACHE.popitem(last=False)
         _ORC_STATS_CACHE[key] = entry
+        _ORC_STATS_CACHE.move_to_end(key)
         cached = entry
     num_rows = max((rows for (_, _, _, rows) in cached.values()
                     if rows >= 0), default=0)

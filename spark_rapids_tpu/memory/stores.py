@@ -26,6 +26,7 @@ _LOG = logging.getLogger("spark_rapids_tpu.memory")
 
 import numpy as np
 
+from spark_rapids_tpu import faults
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
 from spark_rapids_tpu.columnar.host import (
@@ -195,7 +196,7 @@ class BufferCatalog:
                 batch = _numpy_to_batch(meta, bufs)
             else:
                 self.metrics["restore_from_disk"] += 1
-                blob = self._spill_file.read(e.disk_block)
+                blob = self._read_disk_frame(e)
                 if self._codec is not None:
                     blob = self._codec.decompress(
                         blob, e.disk_meta["raw_len"])
@@ -233,7 +234,49 @@ class BufferCatalog:
             elif e.disk_block is not None:
                 self._spill_file.free(e.disk_block)
 
+    def _read_disk_frame(self, e: "BufferEntry") -> bytes:
+        """Read + CRC-verify a spilled frame. A checksum mismatch (bit
+        rot, torn read, injected corruption) re-reads ONCE — wrong data
+        must never deserialize into wrong rows; persistent corruption
+        fails loudly instead."""
+        from spark_rapids_tpu.columnar.wire import (
+            WireCorruptionError, unframe_blob)
+        last: Optional[WireCorruptionError] = None
+        for _ in range(2):
+            faults.fault_point("spill.read")
+            framed = self._spill_file.read(e.disk_block)
+            framed = faults.corrupt_blob("wire", framed)
+            try:
+                return unframe_blob(framed)
+            except WireCorruptionError as err:
+                last = err
+                faults.record("corruptionsDetected")
+                self.metrics["corruption_detected"] = \
+                    self.metrics.get("corruption_detected", 0) + 1
+                _LOG.warning("spill frame checksum mismatch (buffer %d), "
+                             "re-reading: %s", e.buffer_id, err)
+        raise last
+
     # -- OOM recovery --------------------------------------------------------
+    def spill_some(self, target_bytes: Optional[int] = None) -> int:
+        """First escalation rung: spill lowest-priority device buffers
+        until ~``target_bytes`` are freed (default: half the registered
+        device bytes). Returns bytes freed (0 = nothing spillable)."""
+        freed = 0
+        with self._lock:
+            if target_bytes is None:
+                target_bytes = max(self._device_bytes // 2, 1)
+            while freed < target_bytes:
+                victim = self._pick_victim(StorageTier.DEVICE)
+                if victim is None:
+                    break
+                freed += victim.size_bytes
+                self._spill_device_to_host(victim)
+        if freed:
+            self.metrics["oom_spills"] = \
+                self.metrics.get("oom_spills", 0) + 1
+        return freed
+
     def handle_oom(self) -> int:
         """Real HBM allocation failure (not a budget watermark): spill
         EVERY spillable device buffer to host and report bytes freed
@@ -291,11 +334,16 @@ class BufferCatalog:
             self._spill_host_to_disk(victim)
 
     def _spill_host_to_disk(self, e: BufferEntry):
+        from spark_rapids_tpu.columnar.wire import frame_blob
+        faults.fault_point("spill.write")
         blob, directory = _serialize_bufs(e.host_bufs)
         raw_len = len(blob)
         if self._codec is not None:
             blob = self._codec.compress(blob)
-        block = self._spill_file.write(blob)
+        # CRC32-framed on disk: deserialize verifies the frame, so real
+        # or injected corruption is DETECTED instead of decoding into
+        # silently wrong rows (ISSUE 2 wire-integrity contract).
+        block = self._spill_file.write(frame_blob(blob))
         e.disk_meta = dict(e.host_meta)
         e.disk_meta["raw_len"] = raw_len
         e.disk_directory = directory

@@ -1135,8 +1135,11 @@ class HashAggregateExec(Exec):
             # sort. Zero-key aggregates skip this: their masked reductions
             # don't sort, so the concat gather would be pure overhead.
             from spark_rapids_tpu.columnar.batch import coalesce_iter
+            from spark_rapids_tpu.memory.oom import effective_batch_target
             child_iter = coalesce_iter(
-                child_iter, int(ctx.conf.get(C.BATCH_SIZE_ROWS)),
+                child_iter,
+                effective_batch_target(
+                    int(ctx.conf.get(C.BATCH_SIZE_ROWS))),
                 shrink=True,
                 target_bytes=int(ctx.conf.get(C.BATCH_SIZE_BYTES)))
         for batch in child_iter:

@@ -147,7 +147,56 @@ class Exec:
                      partition: int) -> Iterator[HostBatch]:
         raise NotImplementedError
 
+    # -- recovery ------------------------------------------------------------
+    def execute_device_recovering(self, ctx: ExecContext,
+                                  partition: int) -> Iterator[DeviceBatch]:
+        """Device stream with the FINAL OOM escalation rung: when the
+        device path dies on an exhausted spill/shrink ladder
+        (memory/oom.py OomRetryExhausted) BEFORE producing its first
+        batch, re-run this operator subtree on the host engine and
+        upload the results — the reference's operator-by-operator CPU
+        fallback, applied at the dispatch funnels that pull child
+        streams (collect, exchanges, broadcasts). After the first batch
+        is out, consumers have already observed device output, so a
+        mid-stream failure propagates instead of duplicating rows."""
+        from spark_rapids_tpu import config as C, faults
+        from spark_rapids_tpu.memory.oom import OomRetryExhausted
+        it = self.execute_device(ctx, partition)
+        try:
+            first = next(it)
+        except StopIteration:
+            return
+        except OomRetryExhausted as e:
+            if not bool(ctx.conf.get(C.OOM_HOST_FALLBACK)):
+                raise
+            try:
+                host_iter = self.execute_host(ctx, partition)
+            except (NotImplementedError, AssertionError):
+                raise e     # no host path (bridge nodes): nothing to do
+            import logging
+            logging.getLogger("spark_rapids_tpu").warning(
+                "OOM ladder exhausted in %s partition %d; degrading the "
+                "operator subtree to the host engine: %s",
+                self.name, partition, e)
+            faults.record("hostFallbacks")
+            ctx.metrics_for(self).add("hostFallbacks", 1)
+            for hb in host_iter:
+                yield host_to_device(hb)
+            return
+        yield first
+        yield from it
+
     # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _recovery_metrics(ctx: ExecContext) -> Metrics:
+        """The per-query Recovery metrics entry (retriesAttempted /
+        spillEscalations / hostFallbacks / faultsInjected...), surfaced
+        by DataFrame.metrics() next to the per-operator entries."""
+        m = ctx.metrics.get("Recovery@query")
+        if m is None:
+            m = ctx.metrics["Recovery@query"] = Metrics(owner="Recovery")
+        return m
+
     def collect(self, ctx: Optional[ExecContext] = None,
                 device: bool = True) -> List[tuple]:
         """Run all partitions and collect rows (driver collect analog).
@@ -173,16 +222,22 @@ class Exec:
                 max(int(ctx.conf.get(C.CONCURRENT_TPU_TASKS)), 1))
             with sem:
                 # OOM->spill->retry needs the catalog reachable from
-                # dispatch sites deep in the kernel layer (memory/oom.py).
+                # dispatch sites deep in the kernel layer (memory/oom.py);
+                # the recovery sink mirrors ladder/fallback/injection
+                # counters into this query's Metrics.
+                from spark_rapids_tpu import faults
                 from spark_rapids_tpu.memory.oom import set_active_catalog
                 set_active_catalog(ctx.catalog)
+                faults.set_recovery_sink(self._recovery_metrics(ctx))
                 try:
                     batches: List[DeviceBatch] = []
                     for p in range(self.num_partitions(ctx)):
-                        batches.extend(self.execute_device(ctx, p))
+                        batches.extend(
+                            self.execute_device_recovering(ctx, p))
                     host_batches = download_batches(batches, names)
                 finally:
                     set_active_catalog(None)
+                    faults.set_recovery_sink(None)
             # Row materialization is pure host CPU — outside the permit,
             # like the reference releasing GpuSemaphore once the task
             # leaves the device.

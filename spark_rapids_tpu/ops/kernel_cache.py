@@ -240,9 +240,22 @@ def lookup(kind: str, key_parts: Tuple, builder: Callable[[], Callable],
 
 def call(entry: CompiledKernel, metrics, *args, **kwargs):
     """Invoke a cached kernel; if this call compiled it, surface the
-    compile-inclusive first-call time as the op's ``compileTime``."""
+    compile-inclusive first-call time as the op's ``compileTime``.
+
+    Every cached-kernel dispatch is a pure batch->batch computation, so
+    the whole funnel runs under the OOM escalation ladder
+    (memory/oom.py) and carries the ``kernel`` fault-injection site —
+    one hardened choke point instead of per-call-site wrappers."""
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.memory.oom import retry_on_oom
+
     fresh = not entry.compiled
-    out = entry(*args, **kwargs)
+
+    def dispatch():
+        faults.fault_point("kernel")
+        return entry(*args, **kwargs)
+
+    out = retry_on_oom(dispatch)
     if fresh and metrics is not None:
         metrics.add("compileTime", entry.compile_ns)
     return out

@@ -98,6 +98,19 @@ def pandas_to_batch(pdf, schema: Schema) -> HostBatch:
     return HostBatch(names, cols)
 
 
+def _normalize_key(key: tuple) -> tuple:
+    """Group-key tuple with every null encoding (None, float NaN)
+    collapsed to None. pandas hands back ``nan`` for null keys under
+    ``dropna=False``, and two NaN objects from two separate groupbys are
+    neither ``==`` nor (since 3.10) same-hash — so cogrouping by raw
+    keys silently pairs each side's null group with an EMPTY other side.
+    Normalizing to None makes null keys from both sides collide into one
+    cogrouped call (Spark's null-key grouping semantics)."""
+    return tuple(None if v is None
+                 or (isinstance(v, float) and v != v) else v
+                 for v in key)
+
+
 def _group_frames(pdf, key_names: Sequence[str]):
     """(key_tuple, group pdf) in sorted key order; NaN/None keys group
     together (dropna=False, Spark groups null keys)."""
@@ -245,11 +258,13 @@ class CoGroupedMapInPandasExec(_PandasIslandExec):
 
     def _apply(self, ctx, lpdf, rpdf) -> Optional[HostBatch]:
         import pandas as pd
-        lg = dict(_group_frames(lpdf, self.left_keys))
-        rg = dict(_group_frames(rpdf, self.right_keys))
+        lg = {_normalize_key(k): g
+              for k, g in _group_frames(lpdf, self.left_keys)}
+        rg = {_normalize_key(k): g
+              for k, g in _group_frames(rpdf, self.right_keys)}
         keys = sorted(set(lg) | set(rg),
                       key=lambda k: tuple(
-                          (v is None or v != v, 0 if v is None else v)
+                          (v is None, 0 if v is None else v)
                           for v in k))
         if not keys:
             return None

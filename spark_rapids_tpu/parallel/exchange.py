@@ -249,6 +249,8 @@ class ShuffleExchangeExec(Exec):
         _WINDOW = 32
 
         def flush_window(window: List[DeviceBatch]):
+            from spark_rapids_tpu import faults
+            faults.fault_point("exchange.flush")
             if n == 1:
                 # Single destination: no pids, no sort, no slices — shrink
                 # each batch to its live bucket (using hints when known)
@@ -303,7 +305,10 @@ class ShuffleExchangeExec(Exec):
         window: List[DeviceBatch] = []
         window_bytes = 0
         for cp in range(self.children[0].num_partitions(ctx)):
-            for b in self.children[0].execute_device(ctx, cp):
+            # Child pull through the recovery wrapper: an OOM-exhausted
+            # child subtree degrades to the host engine per operator
+            # instead of failing the exchange.
+            for b in self.children[0].execute_device_recovering(ctx, cp):
                 window.append(b)
                 window_bytes += b.device_size_bytes()
                 if len(window) >= _WINDOW or \
@@ -350,7 +355,10 @@ class ShuffleExchangeExec(Exec):
         from spark_rapids_tpu.columnar.batch import jit_concat_batches
         from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
         buckets = self._materialize_device(ctx)
-        target = int(ctx.conf.get(C.BATCH_SIZE_ROWS))
+        # Serve toward the (possibly OOM-degraded) batch target: after a
+        # shrink escalation, reduce-side concats re-dispatch smaller.
+        from spark_rapids_tpu.memory.oom import effective_batch_target
+        target = effective_batch_target(int(ctx.conf.get(C.BATCH_SIZE_ROWS)))
         group: List = []
         group_cap = 0
 
@@ -377,6 +385,8 @@ class ShuffleExchangeExec(Exec):
             return out, []
 
         def serve(sbs):
+            from spark_rapids_tpu import faults
+            faults.fault_point("exchange.serve")
             out, pending = flush(sbs)
             try:
                 yield out
@@ -434,7 +444,8 @@ class BroadcastExchangeExec(Exec):
             return ctx.cache[key]
         batches = []
         for cp in range(self.children[0].num_partitions(ctx)):
-            batches.extend(self.children[0].execute_device(ctx, cp))
+            batches.extend(
+                self.children[0].execute_device_recovering(ctx, cp))
         if not batches:
             raise ValueError("broadcast of empty child needs a schema batch")
         # One batched sizes pull, then shrink members to live scale: the

@@ -206,9 +206,11 @@ class MeshExchangeExec(Exec):
         per_dev: List[List[DeviceBatch]] = [[] for _ in range(n)]
         child = self.children[0]
         for cp in range(child.num_partitions(ctx)):
-            for batch in child.execute_device(ctx, cp):
+            for batch in child.execute_device_recovering(ctx, cp):
                 per_dev[cp % n].append(batch)
         with timed(m, "shuffleTime"):
+            from spark_rapids_tpu import faults
+            faults.fault_point("mesh.exchange")
             shards = _uniform_shards(per_dev, self.schema)
             stacked = M.shard_batches(mesh, shards)
             # Two-phase sizes-then-data (SURVEY §7 hard part 6): exchange

@@ -428,28 +428,56 @@ class PhysicalPlan:
         return "\n".join(lines)
 
     def collect(self, ctx=None):
-        from spark_rapids_tpu.memory.oom import is_transient_error
-        from spark_rapids_tpu.ops.base import ExecContext
+        import time as _time
+
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.memory.oom import (
+            backoff_delay_ms, is_transient_error, reset_degradation)
+        from spark_rapids_tpu.ops.base import ExecContext, Metrics
         owned = ctx is None
         ctx = ctx or ExecContext(self.conf)
+        # Arm the fault schedule ONCE per query (not per attempt: a
+        # retried attempt must run against the REMAINING schedule, or a
+        # count-based transient fault re-fires forever), and clear any
+        # batch-target degradation a previous query's OOM ladder left.
+        faults.maybe_configure(self.conf)
+        reset_degradation()
+        # Failure recovery (SURVEY §5.3): transient backend / tunnel
+        # errors retry the whole query on a fresh context (per-query
+        # caches — shuffles, broadcasts, built sides — are
+        # context-scoped, so each rerun is clean) with exponential
+        # backoff + deterministic jitter, bounded by the per-query
+        # retry budget. Owned contexts only: a caller-provided context
+        # may hold state the caller still needs.
+        max_retries = max(int(self.conf.get(C.RETRY_TRANSIENT_MAX)), 0)
+        base_ms = int(self.conf.get(C.RETRY_BACKOFF_MS))
+        max_ms = int(self.conf.get(C.RETRY_MAX_BACKOFF_MS))
+        seed = int(self.conf.get(C.TEST_FAULTS_SEED))
+        attempt = 0
         try:
-            try:
-                return self.root.collect(ctx, device=self.root_on_device)
-            except Exception as e:
-                # Failure recovery (SURVEY §5.3): a transient backend /
-                # tunnel error retries the whole query ONCE on a fresh
-                # context (per-query caches — shuffles, broadcasts,
-                # built sides — are context-scoped, so the rerun is
-                # clean). Owned contexts only: a caller-provided context
-                # may hold state the caller still needs.
-                if not owned or not is_transient_error(e):
-                    raise
-                import logging
-                logging.getLogger("spark_rapids_tpu").warning(
-                    "transient device error, retrying query once: %s", e)
-                ctx.close()
-                ctx = ExecContext(self.conf)
-                return self.root.collect(ctx, device=self.root_on_device)
+            while True:
+                try:
+                    return self.root.collect(ctx,
+                                             device=self.root_on_device)
+                except Exception as e:
+                    if not owned or not is_transient_error(e) or \
+                            attempt >= max_retries:
+                        raise
+                    delay_ms = backoff_delay_ms(attempt, base_ms, max_ms,
+                                                seed)
+                    import logging
+                    logging.getLogger("spark_rapids_tpu").warning(
+                        "transient device error (attempt %d/%d), "
+                        "retrying query in %.0fms: %s",
+                        attempt + 1, max_retries, delay_ms, e)
+                    _time.sleep(delay_ms / 1000.0)
+                    ctx.close()
+                    ctx = ExecContext(self.conf)
+                    faults.record("retriesAttempted")
+                    rec = ctx.metrics.setdefault(
+                        "Recovery@query", Metrics(owner="Recovery"))
+                    rec.add("retriesAttempted", 1)
+                    attempt += 1
         finally:
             # Metrics survive the collect for DataFrame.metrics().
             self.last_ctx = ctx

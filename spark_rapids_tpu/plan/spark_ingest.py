@@ -69,11 +69,19 @@ def _parse_tree(text: str) -> _Node:
         if not line.strip() or line.lstrip().startswith("=="):
             continue
         m = _NAME_START_RE.search(line)
-        if m is None or m.start() % 3 != 0:
+        if m is None:
             continue
         prefix = line[:m.start()]
         if prefix.strip(" :+-"):
             continue                      # not an operator line
+        if m.start() % 3 != 0:
+            # Looks like an operator line (structural-marker prefix) but
+            # the indent is not a multiple of the 3-char marker width:
+            # silently dropping it would drop an OPERATOR and produce
+            # wrong results downstream (e.g. a vanished Filter).
+            raise SparkPlanParseError(
+                f"operator line has malformed indentation "
+                f"(column {m.start()} is not a multiple of 3): {raw!r}")
         depth = len(prefix) // 3
         head = line[m.start():]
         name = _NAME_START_RE.match(head).group(0)
@@ -543,8 +551,18 @@ def _convert_scan(rest: str, session, tables) -> L.LogicalPlan:
     paths = tables[table]
     df = getattr(session.read, fmt.lower())(*list(paths))
     want = [_clean_name(c) for c in _split_top(cols_s)]
-    if want and set(want) != {n for n in df.columns}:
-        df = df.select(*[c for c in want if c in df.columns])
+    have = set(df.columns)
+    missing = [c for c in want if c not in have]
+    if missing:
+        # The captured plan scans columns the local file does not have:
+        # silently filtering them out would execute a DIFFERENT query
+        # (downstream operators reference the missing attrs or, worse,
+        # quietly lose them).
+        raise SparkPlanParseError(
+            f"scan of table {table!r} wants columns {missing} that the "
+            f"local {fmt} data lacks (file has {sorted(have)})")
+    if want and set(want) != have:
+        df = df.select(*want)
     return df._plan
 
 

@@ -1,0 +1,439 @@
+"""Chaos suite: the recovery machinery under deterministic fault
+injection (ISSUE 2; the continuous-verification analog of the
+reference's spill/retry + CPU-fallback guarantees).
+
+End-to-end: TPC-H queries run under seeded OOM + transient + corruption
+schedules and must return results BIT-IDENTICAL to the fault-free run
+with ``faultsInjected > 0`` and zero unhandled exceptions. Unit level:
+every escalation rung (spill-some, spill-all, shrink, host-fallback)
+provably fires, in order.
+"""
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as srt
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch, host_to_device
+from spark_rapids_tpu.memory import oom
+from spark_rapids_tpu.memory.stores import BufferCatalog, StorageTier
+
+
+@pytest.fixture(autouse=True)
+def clean_fault_state():
+    """Disarm + reset the process-global registry and the degraded batch
+    target around every test (both leak across queries by design)."""
+    faults.configure("")
+    faults.reset_counters()
+    oom.reset_degradation()
+    yield
+    faults.configure("")
+    faults.reset_counters()
+    oom.reset_degradation()
+
+
+# ---------------------------------------------------------------------------
+# TPC-H under seeded fault schedules: bit-identical to the fault-free run
+# ---------------------------------------------------------------------------
+
+QUERIES = ["q1", "q6", "q3"]
+
+# Each schedule mixes fault kinds across dispatch funnels. OOM counts stay
+# at 1 per site so the ladder recovers without reaching the host-fallback
+# rung (host and device float summation orders may legitimately differ in
+# the last ulp; bit-identity is the DEVICE-recovery contract here —
+# host-fallback correctness is proven separately below).
+SCHEDULES = {
+    "oom": "oom@upload:1,oom@kernel:1,oom@concat:1",
+    "transient": ("transient@exchange.flush:1,transient@download:1,"
+                  "oom@kernel:1"),
+    "corrupt": "corrupt@wire:2,oom@upload:1,transient@exchange.serve:1",
+}
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_chaos"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=7)
+    return d
+
+
+def _session(chaos: str = "", spill_dir: str = ""):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    # Explicitly (dis)arm: the registry is process-global and the
+    # baseline run must never inherit a previous query's schedule.
+    s.set("spark.rapids.sql.test.faults", chaos)
+    s.set("spark.rapids.sql.test.faults.seed", 7)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    if chaos:
+        # Pressure the spill tiers so disk frames (the corruption
+        # surface) actually exist and spill rungs have victims; disable
+        # the device scan cache so the upload funnel (and its fault
+        # site) runs on every query instead of serving cached batches.
+        s.set("spark.rapids.memory.tpu.budgetBytes", 1 << 19)
+        s.set("spark.rapids.memory.host.spillStorageSize", 1 << 18)
+        s.set("spark.rapids.sql.format.scanCache.maxBytes", 0)
+        if spill_dir:
+            s.set("spark.rapids.memory.spill.dir", spill_dir)
+    return s
+
+
+@pytest.fixture(scope="module")
+def baselines(data_dir):
+    """Fault-free device results per query (the bit-identity oracle)."""
+    out = {}
+    for qn in QUERIES:
+        out[qn] = tpch.QUERIES[qn](_session(), data_dir).collect()
+    return out
+
+
+@pytest.mark.parametrize("schedule", sorted(SCHEDULES))
+@pytest.mark.parametrize("qname", QUERIES)
+def test_tpch_bit_identical_under_faults(qname, schedule, baselines,
+                                         data_dir, tmp_path):
+    faults.reset_counters()
+    df = tpch.QUERIES[qname](_session(SCHEDULES[schedule],
+                                      str(tmp_path)), data_dir)
+    got = df.collect()          # zero unhandled exceptions, by contract
+    c = faults.counters()
+    assert c.get("faultsInjected", 0) > 0, c
+    # Bit-identical: tuple equality is exact — floats compare by value
+    # (every recovery path re-runs the identical pure computation).
+    assert got == baselines[qname], (
+        f"{qname} under {schedule!r} diverged from the fault-free run")
+
+
+def test_metrics_surface_recovery_counters(data_dir):
+    df = tpch.QUERIES["q6"](_session("oom@upload:1"), data_dir)
+    df.collect()
+    m = df.metrics()
+    rec = m.get("Recovery@query")
+    assert rec is not None and rec.get("faultsInjected", 0) >= 1, m
+
+
+# ---------------------------------------------------------------------------
+# Escalation ladder unit tests: each rung fires, in order
+# ---------------------------------------------------------------------------
+
+def _batch(seed, n=64):
+    rng = np.random.default_rng(seed)
+    hb = HostBatch.from_pydict(
+        [("a", dt.INT64), ("s", dt.STRING)],
+        {"a": rng.integers(0, 1000, n).tolist(),
+         "s": [f"row{seed}_{i}" for i in range(n)]})
+    return host_to_device(hb)
+
+
+def _oom_error():
+    return RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                        "99 bytes")
+
+
+class TestEscalationLadder:
+    def test_rungs_fire_in_order(self, tmp_path):
+        cat = BufferCatalog(device_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(_batch(i)) for i in range(4)]
+        for bid in ids:
+            cat.release(bid)
+        oom.set_active_catalog(cat)
+        calls = []
+        try:
+            def flaky():
+                calls.append(1)
+                if len(calls) <= 3:     # initial + first two rungs fail
+                    raise _oom_error()
+                return "ok"
+
+            assert oom.retry_on_oom(flaky) == "ok"
+        finally:
+            oom.set_active_catalog(None)
+            cat.close()
+        # initial attempt + one retry per acting rung, in ladder order.
+        assert calls == [1, 1, 1, 1]
+        assert oom.last_ladder == [oom.RUNG_SPILL_SOME,
+                                   oom.RUNG_SPILL_ALL,
+                                   oom.RUNG_SHRINK]
+        assert oom.degrade_factor() == 2
+
+    def test_spill_some_spills_half_then_spill_all_rest(self, tmp_path):
+        cat = BufferCatalog(device_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(_batch(i)) for i in range(4)]
+        for bid in ids:
+            cat.release(bid)
+        freed = cat.spill_some()
+        tiers = [cat.tier_of(i) for i in ids]
+        assert freed > 0
+        assert StorageTier.HOST in tiers       # spilled some...
+        assert StorageTier.DEVICE in tiers     # ...but not everything
+        assert cat.handle_oom() > 0            # spill-all takes the rest
+        assert all(cat.tier_of(i) == StorageTier.HOST for i in ids)
+        cat.close()
+
+    def test_shrink_degrades_batch_target_boundedly(self):
+        target = 4 << 20
+        assert oom.effective_batch_target(target) == target
+        assert oom.shrink_batch_target()
+        assert oom.effective_batch_target(target) == target // 2
+        while oom.shrink_batch_target():
+            pass
+        assert oom.degrade_factor() == 8       # bounded
+        assert oom.effective_batch_target(1 << 10) == 1 << 12  # floor
+        oom.reset_degradation()
+        assert oom.effective_batch_target(target) == target
+
+    def test_exhausted_ladder_raises_with_rung_trail(self, tmp_path):
+        cat = BufferCatalog(device_budget_bytes=1 << 30,
+                            spill_dir=str(tmp_path))
+        # 3 buffers: spill-some takes ~half, spill-all takes the rest —
+        # every rung has something to act on.
+        for bid in [cat.add_batch(_batch(i)) for i in range(3)]:
+            cat.release(bid)
+        oom.set_active_catalog(cat)
+        try:
+            def always():
+                raise _oom_error()
+
+            with pytest.raises(oom.OomRetryExhausted) as ei:
+                oom.retry_on_oom(always)
+        finally:
+            oom.set_active_catalog(None)
+            cat.close()
+        assert ei.value.rungs == [oom.RUNG_SPILL_SOME, oom.RUNG_SPILL_ALL,
+                                  oom.RUNG_SHRINK]
+        # No OOM marker: an enclosing retry_on_oom must propagate it
+        # instead of re-running the ladder.
+        assert not oom.is_oom_error(ei.value)
+
+    def test_nothing_actionable_reraises_original(self):
+        # No catalog, degradation already at its bound: every rung is
+        # skipped and the ORIGINAL error propagates unchanged.
+        while oom.shrink_batch_target():
+            pass
+        err = _oom_error()
+
+        def always():
+            raise err
+
+        with pytest.raises(RuntimeError) as ei:
+            oom.retry_on_oom(always)
+        assert ei.value is err
+
+    def test_host_fallback_rung_degrades_operator(self):
+        from spark_rapids_tpu.ops.base import Exec, InMemorySourceExec
+
+        schema = (("a", dt.INT64),)
+        hb = HostBatch.from_pydict(schema, {"a": [1, 2, 3]})
+
+        class FlakyExec(Exec):
+            """Device path exhausts the ladder; host path works."""
+
+            def __init__(self):
+                super().__init__(InMemorySourceExec(schema, [[hb]]))
+
+            @property
+            def schema(self):
+                return schema
+
+            def execute_device(self, ctx, partition):
+                def always():
+                    raise _oom_error()
+                yield oom.retry_on_oom(always)
+
+            def execute_host(self, ctx, partition):
+                yield from self.children[0].execute_host(ctx, partition)
+
+        rows = FlakyExec().collect(device=True)
+        assert rows == [(1,), (2,), (3,)]
+        assert faults.counters().get("hostFallbacks", 0) == 1
+
+    def test_host_fallback_disabled_propagates(self):
+        from spark_rapids_tpu.ops.base import Exec, ExecContext, \
+            InMemorySourceExec
+
+        schema = (("a", dt.INT64),)
+        hb = HostBatch.from_pydict(schema, {"a": [1]})
+
+        class FlakyExec(Exec):
+            def __init__(self):
+                super().__init__(InMemorySourceExec(schema, [[hb]]))
+
+            @property
+            def schema(self):
+                return schema
+
+            def execute_device(self, ctx, partition):
+                def always():
+                    raise _oom_error()
+                yield oom.retry_on_oom(always)
+
+            def execute_host(self, ctx, partition):
+                yield hb
+
+        ctx = ExecContext(srt.TpuConf(
+            {"spark.rapids.sql.oom.hostFallback.enabled": False}))
+        with pytest.raises(oom.OomRetryExhausted):
+            FlakyExec().collect(ctx, device=True)
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# Transient retry: backoff, determinism, budget
+# ---------------------------------------------------------------------------
+
+class TestTransientRetry:
+    def test_backoff_deterministic_exponential_capped(self):
+        d = [oom.backoff_delay_ms(i, 100, 2000, seed=7) for i in range(6)]
+        # Deterministic: same inputs, same delays.
+        assert d == [oom.backoff_delay_ms(i, 100, 2000, seed=7)
+                     for i in range(6)]
+        # Jitter stays in [0.5, 1.0) of the exponential envelope…
+        for i, x in enumerate(d):
+            env = min(100 * 2 ** i, 2000)
+            assert env * 0.5 <= x < env
+        # …and a different seed moves the jitter.
+        assert d != [oom.backoff_delay_ms(i, 100, 2000, seed=8)
+                     for i in range(6)]
+
+    def test_retry_budget_exhausts(self):
+        s = TpuSession()
+        s.set("spark.rapids.sql.test.faults", "transient@download:9")
+        s.set("spark.rapids.sql.retry.transientMaxRetries", 2)
+        s.set("spark.rapids.sql.retry.backoffMs", 1)
+        df = s.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+        with pytest.raises(faults.InjectedTransientError):
+            df.collect()
+        # initial + exactly the budgeted retries ran.
+        assert faults.counters().get("retriesAttempted", 0) >= 2
+
+    def test_transient_recovers_within_budget(self):
+        s = TpuSession()
+        s.set("spark.rapids.sql.test.faults", "transient@download:1")
+        s.set("spark.rapids.sql.retry.backoffMs", 1)
+        df = s.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+        assert sorted(df.collect()) == [(1,), (2,), (3,)]
+        assert faults.counters().get("faultsInjected") == 1
+
+
+# ---------------------------------------------------------------------------
+# Wire integrity: CRC32 frames + corruption injection
+# ---------------------------------------------------------------------------
+
+class TestWireIntegrity:
+    def test_frame_roundtrip_and_detection(self):
+        from spark_rapids_tpu.columnar.wire import (
+            WireCorruptionError, frame_blob, unframe_blob)
+        blob = b"the quick brown batch" * 100
+        framed = frame_blob(blob)
+        assert unframe_blob(framed) == blob
+        # Any single flipped byte — header or payload — is detected.
+        for off in (0, 5, 13, 40, len(framed) - 1):
+            bad = bytearray(framed)
+            bad[off] ^= 0xFF
+            with pytest.raises(WireCorruptionError):
+                unframe_blob(bytes(bad))
+        with pytest.raises(WireCorruptionError):
+            unframe_blob(framed[:10])          # truncated
+        with pytest.raises(WireCorruptionError):
+            unframe_blob(b"XXXX" + framed[4:])  # foreign magic
+
+    def test_injected_disk_corruption_detected_and_recovered(
+            self, tmp_path):
+        b = _batch(0)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 1.5),
+                            host_budget_bytes=int(size * 1.5),
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(_batch(i)) for i in range(4)]
+        tiers = [cat.tier_of(i) for i in ids]
+        assert StorageTier.DISK in tiers
+        disk_id = ids[tiers.index(StorageTier.DISK)]
+        seed = ids.index(disk_id)
+        faults.configure("corrupt@wire:1", seed=7)
+        restored = cat.acquire_batch(disk_id)
+        from spark_rapids_tpu.columnar.host import device_to_host
+        want = device_to_host(_batch(seed)).to_pylist()
+        assert device_to_host(restored).to_pylist() == want
+        assert cat.metrics.get("corruption_detected") == 1
+        assert faults.counters().get("corruptionsDetected") == 1
+        cat.close()
+
+    def test_persistent_corruption_fails_loudly(self, tmp_path):
+        from spark_rapids_tpu.columnar.wire import WireCorruptionError
+        b = _batch(0)
+        size = b.device_size_bytes()
+        cat = BufferCatalog(device_budget_bytes=int(size * 1.5),
+                            host_budget_bytes=int(size * 1.5),
+                            spill_dir=str(tmp_path))
+        ids = [cat.add_batch(_batch(i)) for i in range(4)]
+        tiers = [cat.tier_of(i) for i in ids]
+        disk_id = ids[tiers.index(StorageTier.DISK)]
+        faults.configure("corrupt@wire:5", seed=7)  # every re-read too
+        with pytest.raises(WireCorruptionError):
+            cat.acquire_batch(disk_id)
+        cat.close()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+class TestFaultRegistry:
+    def test_spec_parse(self):
+        es = faults.parse_spec(
+            "oom@upload:0.05, transient@exchange.flush:2 ,corrupt@wire")
+        assert [(e.kind, e.site) for e in es] == [
+            ("oom", "upload"), ("transient", "exchange.flush"),
+            ("corrupt", "wire")]
+        assert es[0].probability == 0.05 and es[0].count is None
+        assert es[1].count == 2
+        assert es[2].count == 1                # default arg
+        for bad in ("oops@upload", "oom@", "oom@x:0", "oom@x:1.5",
+                    "justtext"):
+            with pytest.raises(faults.FaultParseError):
+                faults.parse_spec(bad)
+        assert faults.parse_spec("") == []
+
+    def test_count_faults_fire_first_n_hits(self):
+        inj = faults.FaultInjector("oom@k:2", seed=1)
+        fired = [inj.should_fire("k", ("oom",)) is not None
+                 for _ in range(5)]
+        assert fired == [True, True, False, False, False]
+
+    def test_probability_faults_deterministic_per_seed(self):
+        def run(seed):
+            inj = faults.FaultInjector("oom@k:0.3", seed=seed)
+            return [inj.should_fire("k", ("oom",)) is not None
+                    for _ in range(200)]
+
+        a, b, c = run(7), run(7), run(8)
+        assert a == b                      # same seed, same schedule
+        assert a != c                      # seed moves it
+        assert 20 < sum(a) < 100           # roughly Bernoulli(0.3)
+
+    def test_disarmed_fault_point_is_noop(self):
+        faults.configure("")
+        faults.fault_point("upload")       # must not raise
+        assert faults.corrupt_blob("wire", b"abc") == b"abc"
+
+    def test_fault_point_raises_typed_errors(self):
+        faults.configure("oom@a:1,transient@b:1", seed=0)
+        with pytest.raises(faults.InjectedOomError):
+            faults.fault_point("a")
+        with pytest.raises(faults.InjectedTransientError):
+            faults.fault_point("b")
+        # Markers route into the right recovery machinery.
+        faults.configure("oom@a:1,transient@b:1", seed=0)
+        try:
+            faults.fault_point("a")
+        except Exception as e:
+            assert oom.is_oom_error(e) and not oom.is_transient_error(e)
+        try:
+            faults.fault_point("b")
+        except Exception as e:
+            assert oom.is_transient_error(e) and not oom.is_oom_error(e)

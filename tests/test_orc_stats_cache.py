@@ -1,0 +1,86 @@
+"""Eviction discipline of the ORC stripe-stats cache (io/scan.py): a
+true LRU — hits refresh recency, updates of resident keys never evict,
+and eviction at capacity removes the coldest entry, so warm stripes
+survive a full cache."""
+
+import spark_rapids_tpu.io.scan as scan
+
+
+class _FakeStat:
+    pass
+
+
+def _key(i):
+    return (f"/data/f{i}.orc", 0.0, 100, 0)
+
+
+def test_orc_stats_cache_is_lru(monkeypatch):
+    monkeypatch.setattr(scan, "_ORC_STATS_CACHE_MAX", 3)
+    cache = scan._ORC_STATS_CACHE
+    cache.clear()
+
+    def touch(i, entry=None):
+        """The cache discipline _orc_stripe_stats applies, extracted:
+        move-to-end on hit; evict-oldest only when inserting NEW."""
+        key = _key(i)
+        cached = cache.get(key)
+        if cached is not None:
+            cache.move_to_end(key)
+        if entry is not None:
+            if key not in cache:
+                while len(cache) >= scan._ORC_STATS_CACHE_MAX:
+                    cache.popitem(last=False)
+            cache[key] = entry
+            cache.move_to_end(key)
+
+    touch(0, {"a": (0, 1, 0, 10)})
+    touch(1, {"a": (0, 1, 0, 10)})
+    touch(2, {"a": (0, 1, 0, 10)})
+    assert list(cache) == [_key(0), _key(1), _key(2)]
+
+    # A hit refreshes recency: 0 becomes warmest.
+    touch(0)
+    assert list(cache) == [_key(1), _key(2), _key(0)]
+
+    # Updating a RESIDENT key at capacity evicts nothing.
+    touch(1, {"a": (0, 1, 0, 10), "b": (5, 9, 0, 10)})
+    assert len(cache) == 3
+    assert list(cache) == [_key(2), _key(0), _key(1)]
+
+    # Inserting a genuinely new key evicts only the coldest (2) — the
+    # warm entries 0 and 1 survive at capacity.
+    touch(3, {"a": (0, 1, 0, 10)})
+    assert list(cache) == [_key(0), _key(1), _key(3)]
+    cache.clear()
+
+
+def test_orc_stats_cache_real_path(tmp_path, monkeypatch):
+    """End-to-end through _orc_stripe_stats: repeated probes of the same
+    stripe are hits (stay resident + warm), and new stripes evict the
+    coldest, not the warmest."""
+    import pyarrow as pa
+    import pyarrow.orc as paorc
+
+    paths = []
+    for i in range(4):
+        p = str(tmp_path / f"t{i}.orc")
+        paorc.write_table(pa.table({"x": [i, i + 1, i + 2]}), p)
+        paths.append(p)
+
+    monkeypatch.setattr(scan, "_ORC_STATS_CACHE_MAX", 3)
+    cache = scan._ORC_STATS_CACHE
+    cache.clear()
+
+    def probe(i):
+        unit = scan.ScanUnit(paths[i], 0, 3)
+        stats, rows = scan._orc_stripe_stats(unit, ["x"])
+        assert rows == 3 and stats["x"].min == i
+        return next(k for k in cache if k[0] == paths[i])
+
+    k0, k1, k2 = probe(0), probe(1), probe(2)
+    probe(0)                              # hit: 0 refreshes
+    assert list(cache) == [k1, k2, k0]
+    k3 = probe(3)                         # new key: evicts coldest (1)
+    assert k1 not in cache
+    assert list(cache) == [k2, k0, k3]
+    cache.clear()

@@ -121,3 +121,32 @@ def test_worker_pool_is_bounded():
     _df(s, n=400, parts=1).group_by("g").apply_in_pandas(
         slow, [("g", INT64), ("v", FLOAT64)]).collect()
     assert max(peak) <= 2
+
+
+def test_cogroup_null_keys_collide():
+    """Regression (ISSUE 2 satellite): float-NaN group keys from the two
+    cogrouped sides must land in ONE cogrouped call (Spark null-key
+    grouping), not pair each side's null group with an empty frame —
+    pandas returns nan keys under dropna=False, and two nans from two
+    separate groupbys are neither equal nor same-hash."""
+    s = _session()
+    left = s.create_dataframe(
+        {"k": [1.0, None, None, 2.0], "v": [10.0, 20.0, 30.0, 40.0]},
+        [("k", FLOAT64), ("v", FLOAT64)])
+    right = s.create_dataframe(
+        {"k": [None, 3.0], "w": [100.0, 200.0]},
+        [("k", FLOAT64), ("w", FLOAT64)])
+
+    def merge(lpdf, rpdf):
+        # (left rows, right rows) per cogrouped key: the null key must
+        # see BOTH sides' rows in the same call.
+        return pd.DataFrame({"nl": [float(len(lpdf))],
+                             "nr": [float(len(rpdf))]})
+
+    df = left.group_by("k").cogroup(right.group_by("k")).apply_in_pandas(
+        merge, [("nl", FLOAT64), ("nr", FLOAT64)])
+    got = sorted(df.collect())
+    # Keys: 1.0 (1,0), 2.0 (1,0), 3.0 (0,1), null (2,1) — four calls,
+    # with the two left nulls and one right null cogrouped together.
+    assert got == [(0.0, 1.0), (1.0, 0.0), (1.0, 0.0), (2.0, 1.0)]
+    assert df.collect_host() is not None  # host path tolerates it too

@@ -65,3 +65,26 @@ def test_host_oracle_agrees(data_dir):
     want = df.collect_host()
     assert len(got) == len(want) == 1
     assert abs(got[0][0] - want[0][0]) < 1e-6 * abs(want[0][0])
+
+
+def test_misaligned_operator_line_raises():
+    """ISSUE 2 satellite: a line that looks like an operator but fails
+    the multiple-of-3 indentation check must raise, not silently drop
+    the operator (a vanished Filter = silently wrong results)."""
+    bad = ("*(1) Project [x#1]\n"
+           "  +- Filter (x#1 > 2)\n"           # 2-space indent: malformed
+           "      +- FileScan parquet [x#1]\n")
+    with pytest.raises(SparkPlanParseError, match="indentation"):
+        ingest_spark_plan(bad, _session(), {})
+
+
+def test_scan_missing_columns_raises(data_dir):
+    """ISSUE 2 satellite: a captured scan that wants columns the local
+    file lacks must raise naming them, instead of silently narrowing
+    the scan to a DIFFERENT query."""
+    text = ("*(1) FileScan parquet [l_shipdate#26,no_such_col#99] "
+            "Batched: true, Format: Parquet, Location: "
+            "InMemoryFileIndex[file:/data/tpch/lineitem], "
+            "ReadSchema: struct<l_shipdate:date>\n")
+    with pytest.raises(SparkPlanParseError, match="no_such_col"):
+        ingest_spark_plan(text, _session(), _tables(data_dir))
