@@ -301,9 +301,13 @@ def main():
 
     with _LOCK:
         rec = _faults.counters()
+        # Headline recovery counters always present (zero on a healthy
+        # run); the per-stage detail (stageRecomputes.stage<N>) and
+        # per-site injection detail ride along from the counter map.
         for name in ("faultsInjected", "retriesAttempted",
                      "spillEscalations", "hostFallbacks",
-                     "corruptionsDetected"):
+                     "corruptionsDetected", "stageRecomputes",
+                     "partitionRetries", "watchdogKills", "meshDegrades"):
             rec.setdefault(name, 0)
         out["recovery"] = rec
         _STATE["done"] = True

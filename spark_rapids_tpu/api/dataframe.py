@@ -473,8 +473,12 @@ class DataFrame:
             return {}
         level = str(self._session.conf.get(C.METRICS_LEVEL)).upper()
         keep = self._METRIC_LEVELS.get(level)
+        # The Recovery@query entry (stageRecomputes, watchdogKills,
+        # meshDegrades, retriesAttempted...) is the fault-tolerance audit
+        # trail — never filtered by verbosity level.
         return {k: {name: v for name, v in m.values.items()
-                    if keep is None or name in keep}
+                    if keep is None or name in keep
+                    or m.owner == "Recovery"}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

@@ -406,6 +406,50 @@ OOM_HOST_FALLBACK = conf("spark.rapids.sql.oom.hostFallback.enabled").doc(
     "upload the results (the reference's CPU-fallback-always-available "
     "guarantee applied at the dispatch funnel).").boolean(True)
 
+WATCHDOG_ENABLED = conf("spark.rapids.sql.watchdog.enabled").doc(
+    "Execution watchdog: run each partition's device execution under a "
+    "deadline (taskTimeoutMs) with bounded re-dispatch (maxAttempts) — "
+    "the speculative-re-execution analog of Spark's task-level "
+    "straggler handling, with deterministic first-winner semantics so "
+    "chaos runs stay bit-identical. Off by default: the per-partition "
+    "worker thread is pure overhead on a healthy single-tenant chip."
+).boolean(False)
+
+WATCHDOG_TASK_TIMEOUT_MS = conf(
+    "spark.rapids.sql.watchdog.taskTimeoutMs").doc(
+    "Deadline per watchdog partition attempt. An attempt still running "
+    "at the deadline is killed (cooperative cancel; a wedged device "
+    "call is abandoned to its daemon thread) and re-dispatched."
+).long(600000)
+
+WATCHDOG_MAX_ATTEMPTS = conf("spark.rapids.sql.watchdog.maxAttempts").doc(
+    "Total watchdog attempts per partition (first dispatch + "
+    "re-dispatches). Exhausting them raises DEADLINE_EXCEEDED, handing "
+    "recovery to the transient whole-query retry rung.").integer(2)
+
+STAGE_RECOVERY_ENABLED = conf(
+    "spark.rapids.sql.recovery.stageRecompute.enabled").doc(
+    "Lineage-scoped recovery (parallel/stages.py): split the physical "
+    "plan into a stage DAG at exchange boundaries and, when a durable "
+    "stage output is lost or fails its checksum, invalidate and "
+    "recompute ONLY that stage on the same query context — sibling "
+    "stages serve their still-materialized outputs. Off = every "
+    "recoverable failure falls back to the whole-query retry."
+).boolean(True)
+
+RECOVERY_MAX_STAGE_RECOMPUTES = conf(
+    "spark.rapids.sql.recovery.maxStageRecomputes").doc(
+    "Per-query budget of lineage-scoped stage recomputes before "
+    "recovery demotes to the whole-query retry (a stage that keeps "
+    "losing its output is a sick backend, not a transient blip)."
+).integer(4)
+
+MESH_DEGRADE_ENABLED = conf("spark.rapids.sql.mesh.degrade.enabled").doc(
+    "Graceful mesh degrade: when a mesh collective exchange fails, "
+    "demote this query's exchanges to the single-process "
+    "ShuffleExchangeExec path (counter meshDegrades) instead of killing "
+    "the query. Off = collective failures propagate.").boolean(True)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
@@ -519,17 +563,29 @@ def generate_docs() -> str:
         "kernel, download) walk a bounded escalation ladder instead of",
         "failing: spill-some -> spill-all -> shrink the batch target ->",
         "degrade the operator subtree to the host engine",
-        "(`spark.rapids.sql.oom.hostFallback.enabled`). Transient",
-        "backend/tunnel errors retry the whole query on a fresh context",
-        "with exponential backoff and deterministic jitter, bounded by",
-        "`spark.rapids.sql.retry.transientMaxRetries`. Spilled frames",
+        "(`spark.rapids.sql.oom.hostFallback.enabled`). Execution-side",
+        "failures demote through partition-scoped, then stage-scoped,",
+        "then query-scoped recovery: the execution watchdog",
+        "(`spark.rapids.sql.watchdog.*`) kills and re-dispatches a",
+        "stalled partition; lineage-scoped stage recovery",
+        "(`spark.rapids.sql.recovery.stageRecompute.enabled`) recomputes",
+        "only the stage whose durable exchange output was lost or failed",
+        "its checksum; transient backend/tunnel errors retry first on",
+        "the same context (materialized stages are reused) and only then",
+        "re-run the whole query on a fresh context with exponential",
+        "backoff, bounded by `spark.rapids.sql.retry.transientMaxRetries`.",
+        "A failed mesh collective demotes that query's exchanges to the",
+        "single-process shuffle path",
+        "(`spark.rapids.sql.mesh.degrade.enabled`). Spilled frames",
         "carry a CRC32 checksum verified at deserialize, so corruption",
         "is detected (and re-read once) instead of decoding into wrong",
         "rows. The whole machinery is continuously exercised by",
         "deterministic fault injection (`spark.rapids.sql.test.faults` /",
-        "`SRT_FAULTS`) — see docs/robustness.md and tests/test_chaos.py.",
-        "Recovery counters (retriesAttempted, spillEscalations,",
-        "hostFallbacks, faultsInjected, corruptionsDetected) surface",
+        "`SRT_FAULTS`) — see docs/robustness.md, tests/test_chaos.py and",
+        "tests/test_stage_recovery.py. Recovery counters",
+        "(retriesAttempted, spillEscalations, hostFallbacks,",
+        "faultsInjected, corruptionsDetected, stageRecomputes,",
+        "partitionRetries, watchdogKills, meshDegrades) surface",
         "through `DataFrame.metrics()` and bench.py's JSON report.",
         "",
         "## Dynamic per-rule kill switches",

@@ -18,9 +18,13 @@ env)::
 
 - ``kind``: ``oom`` (raises a synthetic RESOURCE_EXHAUSTED, recovered by
   the OOM escalation ladder), ``transient`` (raises a synthetic
-  UNAVAILABLE, recovered by the planner's whole-query retry), or
-  ``corrupt`` (flips one byte of a serialized frame at a corruption
-  site; detected by the CRC32 frame checksum and re-read).
+  UNAVAILABLE, recovered by the planner's retry ladder), ``corrupt``
+  (flips one byte of a serialized frame at a corruption site; detected
+  by the CRC32 frame checksum and re-read), ``lostoutput`` (simulates a
+  lost durable stage output at an exchange site; recovered by the
+  lineage-scoped stage recompute, parallel/stages.py), or ``stall``
+  (hangs the dispatch until the execution watchdog kills and
+  re-dispatches the partition, ops/base.py).
 - ``site``: a named injection point woven into the dispatch funnels:
   ``upload`` (wire codec device_put), ``download`` (result device_get),
   ``concat`` (batch coalescing), ``kernel`` (cached-kernel dispatch),
@@ -50,7 +54,8 @@ from __future__ import annotations
 import os
 import random
 import threading
-from typing import Dict, List, Optional
+import time
+from typing import Dict, List, Optional, Tuple
 
 
 class InjectedOomError(RuntimeError):
@@ -76,6 +81,37 @@ class InjectedTransientError(RuntimeError):
         self.site = site
 
 
+class InjectedLostOutputError(RuntimeError):
+    """Synthetic loss of a durable stage output (a shuffle/broadcast
+    materialization that vanished or failed its checksum). Carries the
+    UNAVAILABLE marker so, when lineage-scoped recovery is disabled or
+    cannot attribute the loss, the whole-query retry still recovers it.
+    ``fault_owner`` (``id()`` of the owning exchange exec, set by the
+    injection site) lets parallel/stages.py invalidate and recompute
+    just the owning stage instead."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"UNAVAILABLE: injected lost stage output at {site!r} "
+            f"(spark.rapids.sql.test.faults)")
+        self.site = site
+        self.fault_owner: Optional[int] = None
+
+
+class InjectedStallError(RuntimeError):
+    """Raised when an injected stall is cancelled by the execution
+    watchdog (the killed attempt's thread unwinds on it) or when its
+    safety timeout expires with no watchdog armed. The message carries
+    the DEADLINE_EXCEEDED marker so an escaped stall routes into the
+    transient retry instead of failing the query."""
+
+    def __init__(self, site: str):
+        super().__init__(
+            f"DEADLINE_EXCEEDED: injected stall at {site!r} "
+            f"(spark.rapids.sql.test.faults)")
+        self.site = site
+
+
 class FaultSpec:
     """One parsed ``kind@site:arg`` entry."""
 
@@ -94,7 +130,7 @@ class FaultSpec:
         return f"FaultSpec({self.kind}@{self.site}:{arg})"
 
 
-_KINDS = ("oom", "transient", "corrupt")
+_KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall")
 
 
 class FaultParseError(ValueError):
@@ -215,21 +251,67 @@ def maybe_configure(conf) -> None:
     """Arm from ``spark.rapids.sql.test.faults`` when the query's conf
     sets it explicitly (the config wins over SRT_FAULTS); called once
     per query by PhysicalPlan.collect, BEFORE the attempt loop, so
-    transient retries run against the remaining schedule."""
+    transient retries run against the remaining schedule.
+
+    Idempotent against the ARMED schedule: a second collect() with the
+    same (spec, seed) keeps the current injector — and therefore its
+    consumed count-fault state — instead of re-arming a fresh one. A
+    repeated collect after a fault-recovered run must not re-fire
+    already-consumed faults; tests that want a fresh schedule call
+    :func:`configure` directly."""
     from spark_rapids_tpu import config as C
     if C.TEST_FAULTS.key in conf.raw:
-        configure(str(conf.get(C.TEST_FAULTS)),
-                  int(conf.get(C.TEST_FAULTS_SEED)))
+        spec = str(conf.get(C.TEST_FAULTS))
+        seed = int(conf.get(C.TEST_FAULTS_SEED))
+        with _LOCK:
+            cur = _INJECTOR
+            if cur is not None and cur.spec == spec and cur.seed == seed:
+                return
+        configure(spec, seed)
 
 
 def injector() -> Optional[FaultInjector]:
     return _INJECTOR
 
 
+def snapshot() -> Tuple[Optional[FaultInjector], Dict[str, float]]:
+    """Capture the process-global fault state (armed injector + recovery
+    counters) so a test harness can restore it afterwards — chaos tests
+    must never bleed armed schedules or counter state into later tests
+    (tests/conftest.py's autouse fixture)."""
+    with _LOCK:
+        return _INJECTOR, dict(_COUNTERS)
+
+
+def restore(state: Tuple[Optional[FaultInjector], Dict[str, float]]) -> None:
+    """Restore a :func:`snapshot` (the exact injector object, with its
+    consumed-fault state, and the counter values as of the snapshot)."""
+    global _INJECTOR
+    inj, counters = state
+    with _LOCK:
+        _INJECTOR = inj
+        _COUNTERS.clear()
+        _COUNTERS.update(counters)
+
+
 def set_recovery_sink(metrics) -> None:
     """Per-query Metrics object that mirrors the process-global recovery
     counters (set around a collect by ops/base.py)."""
     _TL.sink = metrics
+
+
+def get_recovery_sink():
+    """The calling thread's recovery sink (ops/base.py's watchdog hands
+    it to partition worker threads — thread-locals don't inherit)."""
+    return getattr(_TL, "sink", None)
+
+
+def set_cancel_event(event) -> None:
+    """Register the watchdog's cancel event for the calling (partition
+    worker) thread: an injected ``stall`` waits on it and unwinds with
+    :class:`InjectedStallError` the moment the watchdog kills the
+    attempt, so the abandoned thread exits instead of lingering."""
+    _TL.cancel = event
 
 
 def record(name: str, amount: float = 1) -> None:
@@ -252,20 +334,48 @@ def reset_counters() -> None:
         _COUNTERS.clear()
 
 
-def fault_point(site: str) -> None:
+# Safety net for a stall with no watchdog armed: wait at most this long
+# before unwinding as DEADLINE_EXCEEDED (-> transient retry).
+STALL_TIMEOUT_S = float(os.environ.get("SRT_STALL_TIMEOUT_S", "30"))
+
+
+def _stall(site: str) -> None:
+    """Injected stall: hang this dispatch like a wedged device call.
+    With a watchdog armed (worker thread registered a cancel event) the
+    wait ends the instant the watchdog kills the attempt; without one,
+    the bounded safety timeout expires. Either way the dispatch unwinds
+    with :class:`InjectedStallError` — a stall never 'completes'."""
+    cancel = getattr(_TL, "cancel", None)
+    if cancel is not None:
+        cancel.wait(STALL_TIMEOUT_S)
+    else:
+        time.sleep(STALL_TIMEOUT_S)
+    raise InjectedStallError(site)
+
+
+def fault_point(site: str, owner: Optional[int] = None) -> None:
     """Named injection site. No-op unless a schedule is armed; raises
-    the synthetic error when an ``oom``/``transient`` entry fires."""
+    the synthetic error when an ``oom``/``transient``/``lostoutput``
+    entry fires, or hangs (then unwinds) on a ``stall``. ``owner`` tags
+    a lostoutput with the owning exchange exec's id so lineage recovery
+    can invalidate exactly that stage's output."""
     inj = _INJECTOR
     if inj is None:
         return
-    e = inj.should_fire(site, ("oom", "transient"))
+    e = inj.should_fire(site, ("oom", "transient", "lostoutput", "stall"))
     if e is None:
         return
     record("faultsInjected")
     record(f"faultsInjected.{e.kind}@{site}")
     if e.kind == "oom":
         raise InjectedOomError(site)
-    raise InjectedTransientError(site)
+    if e.kind == "transient":
+        raise InjectedTransientError(site)
+    if e.kind == "lostoutput":
+        err = InjectedLostOutputError(site)
+        err.fault_owner = owner
+        raise err
+    _stall(site)
 
 
 def corrupt_blob(site: str, blob: bytes) -> bytes:

@@ -35,6 +35,9 @@ from spark_rapids_tpu.columnar.host import (
 # SpillPriorities.scala analogs: lower spills first.
 PRIORITY_SHUFFLE_OUTPUT = 0
 PRIORITY_DEFAULT = 50
+# Broadcast singles are re-read by every probe partition: spill them
+# after shuffle buckets and scratch, before actively-read inputs.
+PRIORITY_BROADCAST = 75
 PRIORITY_ACTIVE_INPUT = 100
 
 
@@ -359,6 +362,17 @@ class BufferCatalog:
     def tier_of(self, buffer_id: int) -> str:
         with self._lock:
             return self._entries[buffer_id].tier
+
+    def has(self, buffer_id: int) -> bool:
+        """Whether the buffer is still registered (durable-stage-output
+        liveness probe for the lineage recovery layer and tests)."""
+        with self._lock:
+            return buffer_id in self._entries
+
+    @property
+    def registered_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
 
     @property
     def device_bytes(self) -> int:

@@ -118,6 +118,36 @@ def _visible_device_bytes() -> int:
     return 8 << 30
 
 
+class WatchdogTimeoutError(RuntimeError):
+    """Every watchdog attempt at a partition exceeded its deadline. The
+    message carries the DEADLINE_EXCEEDED marker so the planner's
+    transient retry is the next demotion rung (partition retry -> stage
+    recompute -> whole-query retry)."""
+
+    def __init__(self, op: str, label: str, timeout_ms: int,
+                 attempts: int):
+        super().__init__(
+            f"DEADLINE_EXCEEDED: watchdog killed {op} {label} on all "
+            f"{attempts} attempt(s) of {timeout_ms}ms "
+            "(spark.rapids.sql.watchdog.*)")
+        self.label = label
+
+
+@dataclasses.dataclass
+class _WatchdogParams:
+    timeout_ms: int
+    max_attempts: int
+
+
+def _watchdog_params(conf: TpuConf) -> Optional[_WatchdogParams]:
+    from spark_rapids_tpu import config as C
+    if not bool(conf.get(C.WATCHDOG_ENABLED)):
+        return None
+    return _WatchdogParams(
+        timeout_ms=max(int(conf.get(C.WATCHDOG_TASK_TIMEOUT_MS)), 1),
+        max_attempts=max(int(conf.get(C.WATCHDOG_MAX_ATTEMPTS)), 1))
+
+
 class Exec:
     """A physical operator. Subclasses implement the per-partition device
     and host paths. ``schema`` is the output schema."""
@@ -186,6 +216,76 @@ class Exec:
         yield first
         yield from it
 
+    def _watchdog_run(self, ctx: ExecContext, wd: "_WatchdogParams",
+                      label: str, fn):
+        """Execution watchdog (spark.rapids.sql.watchdog.*): run one unit
+        of device work (a partition's stream, or the partition-count /
+        AQE materialization step) under a deadline with bounded
+        re-dispatch — the speculative-re-execution half of the fault
+        story (Dean & Ghemawat, MapReduce, OSDI 2004), scoped to a
+        partition instead of the query.
+
+        Deterministic first-winner semantics: attempts run strictly
+        serially, the first attempt to COMPLETE within its deadline wins,
+        and a killed attempt's partial output is discarded whole — the
+        computation is pure batch->batch, so whichever attempt wins, the
+        result is bit-identical. Kills are cooperative: the attempt
+        thread gets a cancel event that injected stalls (and any future
+        cancellation-aware dispatch) unwind on; a truly wedged device
+        call is abandoned to its daemon thread."""
+        import threading
+
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.memory.oom import (get_active_catalog,
+                                                 set_active_catalog)
+        timeout_s = wd.timeout_ms / 1000.0
+        catalog = get_active_catalog()
+        sink = faults.get_recovery_sink()
+        for attempt in range(wd.max_attempts):
+            cancel = threading.Event()
+            box: Dict[str, object] = {}
+
+            def work():
+                # Thread-locals don't inherit: the worker needs the
+                # query's spill catalog (OOM ladder), recovery sink, and
+                # its attempt's cancel event.
+                set_active_catalog(catalog)
+                faults.set_recovery_sink(sink)
+                faults.set_cancel_event(cancel)
+                try:
+                    box["out"] = fn()
+                except BaseException as e:
+                    box["err"] = e
+
+            t = threading.Thread(
+                target=work, daemon=True,
+                name=f"srt-watchdog-{label}-a{attempt}")
+            t.start()
+            t.join(timeout_s)
+            if not t.is_alive():
+                err = box.get("err")
+                if err is not None:
+                    raise err
+                return box["out"]
+            cancel.set()
+            faults.record("watchdogKills")
+            ctx.metrics_for(self).add("watchdogKills", 1)
+            import logging
+            logging.getLogger("spark_rapids_tpu").warning(
+                "watchdog: %s %s exceeded %dms (attempt %d/%d)"
+                "; killing and %s", self.name, label, wd.timeout_ms,
+                attempt + 1, wd.max_attempts,
+                "re-dispatching" if attempt + 1 < wd.max_attempts
+                else "giving up")
+            # Grace join: a cooperatively-cancelled attempt (injected
+            # stall) unwinds immediately, so the re-dispatch rarely
+            # overlaps the old thread.
+            t.join(0.2)
+            if attempt + 1 < wd.max_attempts:
+                faults.record("partitionRetries")
+        raise WatchdogTimeoutError(self.name, label, wd.timeout_ms,
+                                   wd.max_attempts)
+
     # -- helpers -------------------------------------------------------------
     @staticmethod
     def _recovery_metrics(ctx: ExecContext) -> Metrics:
@@ -230,10 +330,26 @@ class Exec:
                 set_active_catalog(ctx.catalog)
                 faults.set_recovery_sink(self._recovery_metrics(ctx))
                 try:
+                    wd = _watchdog_params(ctx.conf)
                     batches: List[DeviceBatch] = []
-                    for p in range(self.num_partitions(ctx)):
-                        batches.extend(
-                            self.execute_device_recovering(ctx, p))
+                    if wd is None:
+                        for p in range(self.num_partitions(ctx)):
+                            batches.extend(
+                                self.execute_device_recovering(ctx, p))
+                    else:
+                        # The partition count itself can trigger device
+                        # work (AQE coalescing materializes the exchange
+                        # to learn exact bucket sizes), so it runs under
+                        # the watchdog too.
+                        nparts = self._watchdog_run(
+                            ctx, wd, "partition-count",
+                            lambda: self.num_partitions(ctx))
+                        for p in range(nparts):
+                            batches.extend(self._watchdog_run(
+                                ctx, wd, f"partition {p}",
+                                lambda p=p: list(
+                                    self.execute_device_recovering(
+                                        ctx, p))))
                     host_batches = download_batches(batches, names)
                 finally:
                     set_active_catalog(None)

@@ -1,8 +1,11 @@
 """Parallelism & distribution (SURVEY.md §2.5/§2.6): partitioning
-strategies, shuffle/broadcast exchanges, device-mesh collectives."""
+strategies, shuffle/broadcast exchanges, device-mesh collectives, and
+the stage-graph lineage recovery layer (parallel/stages.py)."""
 
 from spark_rapids_tpu.parallel.partitioning import (   # noqa: F401
     HashPartitioning, Partitioning, RangePartitioning,
     RoundRobinPartitioning, SinglePartitioning, split_batch)
 from spark_rapids_tpu.parallel.exchange import (       # noqa: F401
     BroadcastExchangeExec, ShuffleExchangeExec)
+from spark_rapids_tpu.parallel.stages import (         # noqa: F401
+    Stage, StageGraph, build_stage_graph)

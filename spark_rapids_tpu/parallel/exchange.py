@@ -250,7 +250,7 @@ class ShuffleExchangeExec(Exec):
 
         def flush_window(window: List[DeviceBatch]):
             from spark_rapids_tpu import faults
-            faults.fault_point("exchange.flush")
+            faults.fault_point("exchange.flush", owner=id(self))
             if n == 1:
                 # Single destination: no pids, no sort, no slices — shrink
                 # each batch to its live bucket (using hints when known)
@@ -304,20 +304,31 @@ class ShuffleExchangeExec(Exec):
         max_window_bytes = max(ctx.catalog.device_budget // 4, 1 << 20)
         window: List[DeviceBatch] = []
         window_bytes = 0
-        for cp in range(self.children[0].num_partitions(ctx)):
-            # Child pull through the recovery wrapper: an OOM-exhausted
-            # child subtree degrades to the host engine per operator
-            # instead of failing the exchange.
-            for b in self.children[0].execute_device_recovering(ctx, cp):
-                window.append(b)
-                window_bytes += b.device_size_bytes()
-                if len(window) >= _WINDOW or \
-                        window_bytes >= max_window_bytes:
-                    flush_window(window)
-                    window = []
-                    window_bytes = 0
-        if window:
-            flush_window(window)
+        try:
+            for cp in range(self.children[0].num_partitions(ctx)):
+                # Child pull through the recovery wrapper: an
+                # OOM-exhausted child subtree degrades to the host engine
+                # per operator instead of failing the exchange.
+                for b in self.children[0].execute_device_recovering(ctx,
+                                                                    cp):
+                    window.append(b)
+                    window_bytes += b.device_size_bytes()
+                    if len(window) >= _WINDOW or \
+                            window_bytes >= max_window_bytes:
+                        flush_window(window)
+                        window = []
+                        window_bytes = 0
+            if window:
+                flush_window(window)
+        except BaseException:
+            # Partial materialization must not leak catalog entries: the
+            # planner's retry ladder (stage recompute / transient retry
+            # on the same context) re-runs this materialization from
+            # scratch, so whatever was bucketed so far is garbage.
+            for blist in buckets:
+                for sb in blist:
+                    sb.close()
+            raise
         ctx.cache[key] = buckets
         ctx.cache[key + ":rows"] = bucket_rows
         return buckets
@@ -386,8 +397,17 @@ class ShuffleExchangeExec(Exec):
 
         def serve(sbs):
             from spark_rapids_tpu import faults
-            faults.fault_point("exchange.serve")
-            out, pending = flush(sbs)
+            from spark_rapids_tpu.columnar.wire import WireCorruptionError
+            faults.fault_point("exchange.serve", owner=id(self))
+            try:
+                out, pending = flush(sbs)
+            except WireCorruptionError as err:
+                # A durable stage output failed its CRC even after the
+                # re-read: the data at rest is gone. Tag the loss with
+                # this exchange so lineage recovery recomputes just this
+                # stage instead of failing the query.
+                err.fault_owner = id(self)
+                raise
             try:
                 yield out
             finally:
@@ -419,6 +439,22 @@ class ShuffleExchangeExec(Exec):
         buckets = self._materialize_host(ctx)
         yield from iter(buckets[partition])
 
+    # -- lineage recovery ----------------------------------------------------
+    def stage_invalidate(self, ctx) -> None:
+        """Drop this exchange's durable stage output (parallel/stages.py
+        boundary contract): close every bucket's catalog registration
+        and forget the materialization, so the next execution recomputes
+        this stage from its parents' still-cached outputs."""
+        dev_key = self._cache_key(True)
+        buckets = ctx.cache.pop(dev_key, None)
+        ctx.cache.pop(dev_key + ":rows", None)
+        ctx.cache.pop(self._cache_key(False), None)
+        ctx.cache.pop(f"shuffle-groups:{id(self):x}", None)
+        if buckets:
+            for blist in buckets:
+                for sb in blist:
+                    sb.close()
+
 
 class BroadcastExchangeExec(Exec):
     """Collect the whole child into ONE batch replicated to every consumer
@@ -439,9 +475,18 @@ class BroadcastExchangeExec(Exec):
         return f"broadcast:{id(self):x}:{'dev' if device else 'host'}"
 
     def collect_single_device(self, ctx) -> DeviceBatch:
+        # The merged single is a durable stage output: registered with
+        # the buffer catalog (spillable under the memory ladder, CRC
+        # framed once it reaches disk) instead of pinned raw in
+        # ctx.cache, and re-acquired from whatever tier it sits on.
+        from spark_rapids_tpu.memory.stores import (PRIORITY_BROADCAST,
+                                                    SpillableBatch)
         key = self._cache_key(True)
-        if key in ctx.cache:
-            return ctx.cache[key]
+        handle = ctx.cache.get(key)
+        if handle is not None:
+            batch = handle.get()
+            handle.release(PRIORITY_BROADCAST)
+            return batch
         batches = []
         for cp in range(self.children[0].num_partitions(ctx)):
             batches.extend(
@@ -461,7 +506,8 @@ class BroadcastExchangeExec(Exec):
         total = sum(b.capacity for b in batches)
         single = batches[0] if len(batches) == 1 else \
             concat_batches(batches, bucket_capacity(total))
-        ctx.cache[key] = single
+        ctx.cache[key] = SpillableBatch(ctx.catalog, single,
+                                        PRIORITY_BROADCAST)
         return single
 
     def collect_single_host(self, ctx) -> HostBatch:
@@ -475,7 +521,23 @@ class BroadcastExchangeExec(Exec):
         from spark_rapids_tpu.columnar.host import concat_host_batches
         merged = concat_host_batches(hbs)
         ctx.cache[key] = merged
+        # Host path while a device copy exists = the host-fallback rung
+        # degraded an operator subtree over this broadcast. The degraded
+        # consumer reads the host copy; keeping the device single too
+        # would pin BOTH for the query's lifetime, so free the device
+        # side (a later device consumer rebuilds it).
+        dev = ctx.cache.pop(self._cache_key(True), None)
+        if dev is not None:
+            dev.close()
         return merged
+
+    def stage_invalidate(self, ctx) -> None:
+        """Drop the broadcast's durable output (stage boundary contract,
+        parallel/stages.py)."""
+        dev = ctx.cache.pop(self._cache_key(True), None)
+        ctx.cache.pop(self._cache_key(False), None)
+        if dev is not None:
+            dev.close()
 
     def execute_device(self, ctx, partition):
         yield self.collect_single_device(ctx)

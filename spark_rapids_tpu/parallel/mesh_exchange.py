@@ -193,7 +193,40 @@ class MeshExchangeExec(Exec):
             local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
             out_specs=P(M.DATA_AXIS)))
 
-    def _materialize(self, ctx) -> List[DeviceBatch]:
+    def _fallback(self):
+        """Single-process materialized exchange over the same child and
+        partitioning — the demotion target when the mesh collective
+        fails. Built lazily, once per exec instance, so its per-context
+        materialization caches key stably across retries."""
+        fb = getattr(self, "_fallback_exec", None)
+        if fb is None:
+            from spark_rapids_tpu.parallel.exchange import \
+                ShuffleExchangeExec
+            fb = self._fallback_exec = ShuffleExchangeExec(
+                self.children[0], self.partitioning)
+        return fb
+
+    def _degrade(self, ctx, err) -> None:
+        """Mesh degrade: the collective failed, so demote THIS QUERY's
+        mesh exchanges to the single-process ShuffleExchangeExec path
+        instead of killing the query. The flag is context-scoped — every
+        other MeshExchangeExec in the plan skips its collective too (a
+        sick interconnect rarely fails just one exchange)."""
+        import logging
+        from spark_rapids_tpu import faults
+        logging.getLogger("spark_rapids_tpu").warning(
+            "mesh collective failed in %s; degrading this query's "
+            "exchanges to the single-process shuffle path: %s",
+            self.name, err)
+        faults.record("meshDegrades")
+        ctx.metrics_for(self).add("meshDegrades", 1)
+        ctx.cache["mesh.degraded"] = True
+
+    def _materialize(self, ctx) -> Optional[List]:
+        """Run the collective and register each device's post-exchange
+        shard as a durable stage output (spillable catalog handle).
+        Returns None after a graceful degrade — the caller serves from
+        the single-process fallback exchange instead."""
         key = f"meshx:{id(self):x}"
         if key in ctx.cache:
             return ctx.cache[key]
@@ -208,44 +241,85 @@ class MeshExchangeExec(Exec):
         for cp in range(child.num_partitions(ctx)):
             for batch in child.execute_device_recovering(ctx, cp):
                 per_dev[cp % n].append(batch)
+        from spark_rapids_tpu import config as C
         with timed(m, "shuffleTime"):
-            from spark_rapids_tpu import faults
-            faults.fault_point("mesh.exchange")
-            shards = _uniform_shards(per_dev, self.schema)
-            stacked = M.shard_batches(mesh, shards)
-            # Two-phase sizes-then-data (SURVEY §7 hard part 6): exchange
-            # per-destination COUNTS first (a (n,n) int32 collective +
-            # one host pull), size the data collective's static piece
-            # capacity to the observed max instead of the worst case —
-            # the default padding is an n-fold wire inflation at scale.
-            # n == 1 skips the phase: the collective moves nothing, so
-            # the counts sync could only cost.
-            from spark_rapids_tpu.ops import kernel_cache as kc
-            mkey = self._mesh_key(mesh)
-            pids_fn = kc.lookup("mesh-pids", mkey,
-                                lambda: self._pids_step(mesh), m)
-            pids = pids_fn(stacked)
-            piece_cap = None
-            if n > 1 and shards[0].capacity >= TWO_PHASE_MIN_SHARD_ROWS:
-                counts_fn = kc.lookup(
-                    "mesh-counts", mkey,
-                    lambda: self._counts_step(mesh, n), m)
-                counts = np.asarray(counts_fn(stacked, pids))
-                piece_cap = bucket_capacity(max(int(counts.max()), 1))
-                if piece_cap >= shards[0].capacity:
-                    piece_cap = None    # padding wouldn't shrink anything
-            step = kc.lookup(
-                "mesh-exchange", mkey + (piece_cap,),
-                lambda: self._build_step(mesh, n,
-                                         piece_capacity=piece_cap), m)
-            out = step(stacked, pids)
-            parts = _addressable_parts(out, n)
-        ctx.cache[key] = parts
-        return parts
+            try:
+                from spark_rapids_tpu import faults
+                faults.fault_point("mesh.exchange", owner=id(self))
+                shards = _uniform_shards(per_dev, self.schema)
+                stacked = M.shard_batches(mesh, shards)
+                # Two-phase sizes-then-data (SURVEY §7 hard part 6):
+                # exchange per-destination COUNTS first (a (n,n) int32
+                # collective + one host pull), size the data collective's
+                # static piece capacity to the observed max instead of
+                # the worst case — the default padding is an n-fold wire
+                # inflation at scale. n == 1 skips the phase: the
+                # collective moves nothing, so the counts sync could
+                # only cost.
+                from spark_rapids_tpu.ops import kernel_cache as kc
+                mkey = self._mesh_key(mesh)
+                pids_fn = kc.lookup("mesh-pids", mkey,
+                                    lambda: self._pids_step(mesh), m)
+                pids = pids_fn(stacked)
+                piece_cap = None
+                if n > 1 and shards[0].capacity >= \
+                        TWO_PHASE_MIN_SHARD_ROWS:
+                    counts_fn = kc.lookup(
+                        "mesh-counts", mkey,
+                        lambda: self._counts_step(mesh, n), m)
+                    counts = np.asarray(counts_fn(stacked, pids))
+                    piece_cap = bucket_capacity(max(int(counts.max()), 1))
+                    if piece_cap >= shards[0].capacity:
+                        piece_cap = None  # padding wouldn't shrink
+                step = kc.lookup(
+                    "mesh-exchange", mkey + (piece_cap,),
+                    lambda: self._build_step(mesh, n,
+                                             piece_capacity=piece_cap), m)
+                out = step(stacked, pids)
+                parts = _addressable_parts(out, n)
+            except Exception as err:
+                if not bool(ctx.conf.get(C.MESH_DEGRADE_ENABLED)):
+                    raise
+                self._degrade(ctx, err)
+                return None
+        # Durable stage outputs: each shard registers with the buffer
+        # catalog (bounded by the memory ladder; CRC-framed once spilled
+        # to disk) instead of pinning raw HBM in ctx.cache.
+        from spark_rapids_tpu.memory.stores import (
+            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+        handles = [SpillableBatch(ctx.catalog, p, PRIORITY_SHUFFLE_OUTPUT)
+                   for p in parts]
+        ctx.cache[key] = handles
+        return handles
 
     def execute_device(self, ctx, partition):
-        parts = self._materialize(ctx)
-        yield parts[partition]
+        handles = None
+        if not ctx.cache.get("mesh.degraded"):
+            handles = self._materialize(ctx)
+        if handles is None:       # degraded (now or by a prior exchange)
+            yield from self._fallback().execute_device(ctx, partition)
+            return
+        h = handles[partition]
+        batch = h.get()
+        try:
+            yield batch
+        finally:
+            from spark_rapids_tpu.memory.stores import \
+                PRIORITY_SHUFFLE_OUTPUT
+            h.release(PRIORITY_SHUFFLE_OUTPUT)
+
+    # -- lineage recovery ----------------------------------------------------
+    def stage_invalidate(self, ctx) -> None:
+        """Drop this exchange's durable shards (stage boundary contract,
+        parallel/stages.py)."""
+        handles = ctx.cache.pop(f"meshx:{id(self):x}", None)
+        ctx.cache.pop(f"meshx-host:{id(self):x}", None)
+        if handles:
+            for h in handles:
+                h.close()
+        fb = getattr(self, "_fallback_exec", None)
+        if fb is not None:
+            fb.stage_invalidate(ctx)
 
     def execute_host(self, ctx, partition):
         # Host engine has no mesh; fall back to the materialized exchange

@@ -434,6 +434,7 @@ class PhysicalPlan:
         from spark_rapids_tpu.memory.oom import (
             backoff_delay_ms, is_transient_error, reset_degradation)
         from spark_rapids_tpu.ops.base import ExecContext, Metrics
+        from spark_rapids_tpu.parallel import stages as S
         owned = ctx is None
         ctx = ctx or ExecContext(self.conf)
         # Arm the fault schedule ONCE per query (not per attempt: a
@@ -442,38 +443,86 @@ class PhysicalPlan:
         # batch-target degradation a previous query's OOM ladder left.
         faults.maybe_configure(self.conf)
         reset_degradation()
-        # Failure recovery (SURVEY §5.3): transient backend / tunnel
-        # errors retry the whole query on a fresh context (per-query
-        # caches — shuffles, broadcasts, built sides — are
-        # context-scoped, so each rerun is clean) with exponential
-        # backoff + deterministic jitter, bounded by the per-query
-        # retry budget. Owned contexts only: a caller-provided context
-        # may hold state the caller still needs.
+        # Failure recovery ladder (SURVEY §5.3 + lineage-scoped recovery,
+        # parallel/stages.py), scoped-smallest-first:
+        #
+        # 1. STAGE RECOMPUTE — a failure attributable to one stage's lost
+        #    durable output (lostoutput injection, persistent checksum
+        #    failure of a materialized exchange buffer) invalidates just
+        #    that stage and re-runs the collect on the SAME context:
+        #    every sibling stage serves its cached materialization, so
+        #    only the lost lineage recomputes. Bounded by
+        #    spark.rapids.sql.recovery.maxStageRecomputes.
+        # 2. SAME-CONTEXT TRANSIENT RETRY — the first transient
+        #    backend/tunnel error also retries on the same context
+        #    (materialized stage outputs are data at rest; discarding
+        #    them re-runs work the failure never touched).
+        # 3. WHOLE-QUERY RETRY — repeated transients (possibly poisoned
+        #    device state) or an unattributable/budget-exhausted loss
+        #    fall back to a fresh context, with exponential backoff +
+        #    deterministic jitter, bounded by the per-query budget.
+        #
+        # Owned contexts only: a caller-provided context may hold state
+        # the caller still needs.
         max_retries = max(int(self.conf.get(C.RETRY_TRANSIENT_MAX)), 0)
         base_ms = int(self.conf.get(C.RETRY_BACKOFF_MS))
         max_ms = int(self.conf.get(C.RETRY_MAX_BACKOFF_MS))
         seed = int(self.conf.get(C.TEST_FAULTS_SEED))
+        graph = None
+        if owned and bool(self.conf.get(C.STAGE_RECOVERY_ENABLED)):
+            graph = S.build_stage_graph(self.root)
+        stage_budget = max(
+            int(self.conf.get(C.RECOVERY_MAX_STAGE_RECOMPUTES)), 0)
+        stage_recomputes = 0
+        same_ctx_retry_used = False
         attempt = 0
+        import logging
+        log = logging.getLogger("spark_rapids_tpu")
         try:
             while True:
                 try:
                     return self.root.collect(ctx,
                                              device=self.root_on_device)
                 except Exception as e:
-                    if not owned or not is_transient_error(e) or \
-                            attempt >= max_retries:
+                    if not owned:
+                        raise
+                    # Rung 1: lineage-scoped stage recompute.
+                    st = S.stage_for_error(graph, e)
+                    if st is not None and stage_recomputes < stage_budget:
+                        S.invalidate_stage(ctx, st)
+                        S.record_recompute(ctx, st)
+                        stage_recomputes += 1
+                        log.warning(
+                            "lost stage output (%s, recompute %d/%d); "
+                            "recomputing only that stage: %s",
+                            st.name, stage_recomputes, stage_budget, e)
+                        continue
+                    if not is_transient_error(e) or attempt >= max_retries:
                         raise
                     delay_ms = backoff_delay_ms(attempt, base_ms, max_ms,
                                                 seed)
-                    import logging
-                    logging.getLogger("spark_rapids_tpu").warning(
-                        "transient device error (attempt %d/%d), "
-                        "retrying query in %.0fms: %s",
-                        attempt + 1, max_retries, delay_ms, e)
-                    _time.sleep(delay_ms / 1000.0)
-                    ctx.close()
-                    ctx = ExecContext(self.conf)
                     faults.record("retriesAttempted")
+                    if graph is not None and not same_ctx_retry_used:
+                        # Rung 2: retry on the same context — completed
+                        # stages serve their durable outputs instead of
+                        # recomputing.
+                        same_ctx_retry_used = True
+                        log.warning(
+                            "transient device error (attempt %d/%d), "
+                            "retrying on the same context in %.0fms "
+                            "(materialized stage outputs are kept): %s",
+                            attempt + 1, max_retries, delay_ms, e)
+                        _time.sleep(delay_ms / 1000.0)
+                    else:
+                        # Rung 3: whole-query retry on a fresh context.
+                        log.warning(
+                            "transient device error (attempt %d/%d), "
+                            "retrying query on a fresh context in "
+                            "%.0fms: %s",
+                            attempt + 1, max_retries, delay_ms, e)
+                        _time.sleep(delay_ms / 1000.0)
+                        ctx.close()
+                        ctx = ExecContext(self.conf)
                     rec = ctx.metrics.setdefault(
                         "Recovery@query", Metrics(owner="Recovery"))
                     rec.add("retriesAttempted", 1)

@@ -38,3 +38,20 @@ if os.environ.get("SRT_STAGE_FUSION") == "0":
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _fault_state_isolation():
+    """Snapshot + restore the process-global fault registry and recovery
+    counters around EVERY test: a chaos test that arms a schedule (via
+    faults.configure or a session conf collect) can no longer bleed an
+    armed schedule or counter state into later tests, and an env-armed
+    schedule (SRT_FAULTS) survives each test with exactly the state it
+    entered with. The degraded batch target resets too — it is process
+    state the OOM shrink rung leaks by design."""
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.memory import oom
+    state = faults.snapshot()
+    yield
+    faults.restore(state)
+    oom.reset_degradation()

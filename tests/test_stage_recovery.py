@@ -1,0 +1,402 @@
+"""Lineage-scoped recovery suite (ISSUE 3): stage DAG + durable stage
+outputs, the execution watchdog, and mesh degrade.
+
+The contract under test, scoped smallest first:
+
+- a ``stall`` is killed by the watchdog and the PARTITION retry succeeds
+  within ``watchdog.maxAttempts``;
+- a ``lostoutput`` on a reduce-side read recomputes ONLY the owning
+  stage (``stageRecomputes == 1``; sibling stages' scans never re-run),
+  with results bit-identical to the fault-free run;
+- a failed mesh collective demotes that query's exchanges to the
+  single-process shuffle path (``meshDegrades``) instead of dying;
+- a repeated collect() after a fault-recovered collect is bit-identical
+  and does NOT re-fire already-consumed count faults.
+
+The CI chaos matrix runs this file (including the slow-marked TPC-H
+q3/q6 runs under the watchdog) with a fixed seed.
+"""
+
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import HostBatch
+from spark_rapids_tpu.ops.base import ExecContext, InMemorySourceExec
+from spark_rapids_tpu.parallel import stages as S
+from spark_rapids_tpu.plan.logical import agg_sum, col
+
+
+@pytest.fixture(autouse=True)
+def clean_fault_state():
+    """Explicitly disarm around every test (the conftest snapshot
+    fixture restores prior state; this pins a known-clean start)."""
+    faults.configure("")
+    faults.reset_counters()
+    yield
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_stagerec"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=7)
+    return d
+
+
+def _session(chaos: str = "") -> TpuSession:
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.test.faults", chaos)
+    s.set("spark.rapids.sql.test.faults.seed", 7)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    # Scan counters must reflect real (re-)execution, and shuffle joins
+    # give q3 its 2-exchange reduce-side shape.
+    s.set("spark.rapids.sql.format.scanCache.maxBytes", 0)
+    s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    return s
+
+
+def _scan_batch_counts(df):
+    """numOutputBatches per FileScanExec instance of the LAST collect,
+    ordered stably by the scan's first file path (the per-table identity
+    two different plans of the same query share)."""
+    from spark_rapids_tpu.io.scan import FileScanExec
+    phys = df._physical()
+    ctx = phys.last_ctx
+    out = {}
+
+    def walk(op):
+        if isinstance(op, FileScanExec):
+            m = ctx.metrics.get(f"{op.name}@{id(op):x}")
+            out[min(op.paths)] = \
+                (m.values.get("numOutputBatches", 0) if m else 0)
+        for c in op.children:
+            walk(c)
+
+    walk(phys.root)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Stage DAG structure
+# ---------------------------------------------------------------------------
+
+class TestStageGraph:
+    def _join_df(self, s):
+        left = s.create_dataframe(
+            {"k": [1, 2, 3, 4], "v": [10, 20, 30, 40]},
+            [("k", dt.INT64), ("v", dt.INT64)])
+        right = s.create_dataframe(
+            {"k": [2, 3, 4, 5], "w": [200, 300, 400, 500]},
+            [("k", dt.INT64), ("w", dt.INT64)])
+        return left.join_on(right, ["k"], ["k"], strategy="shuffle")
+
+    def test_two_exchange_join_builds_three_stages(self):
+        phys = self._join_df(_session())._physical()
+        g = S.build_stage_graph(phys.root)
+        assert len(g) == 3
+        result = g.stages[g.root_stage_id]
+        assert result.boundary is None
+        assert sorted(result.parents) == sorted(
+            sid for sid in g.stages if sid != g.root_stage_id)
+        for sid in result.parents:
+            st = g.stages[sid]
+            assert S.is_stage_boundary(st.boundary)
+            assert g.stage_of_exchange(id(st.boundary)) is st
+
+    def test_q3_stage_lineage(self, data_dir):
+        phys = tpch.QUERIES["q3"](_session(), data_dir)._physical()
+        g = S.build_stage_graph(phys.root)
+        # Shuffle-forced q3: join exchanges x4, aggregate exchange,
+        # range-sort exchange, global-limit single exchange + the
+        # result stage.
+        assert len(g) >= 6
+        # Every exchange is resolvable back to exactly one stage.
+        boundaries = [st.boundary for st in g.stages.values()
+                      if st.boundary is not None]
+        assert len({id(b) for b in boundaries}) == len(boundaries)
+        # Lineage is a DAG rooted at the result stage: every non-result
+        # stage is some stage's parent.
+        children = {sid for st in g.stages.values() for sid in st.parents}
+        assert children == set(g.stages) - {g.root_stage_id}
+
+    def test_stage_invalidate_closes_buckets_and_recomputes(self):
+        df = _session().create_dataframe(
+            {"a": list(range(16))}, [("a", dt.INT64)],
+            num_partitions=2).repartition(4, "a")
+        phys = df._physical()
+        g = S.build_stage_graph(phys.root)
+        assert len(g) == 2
+        ctx = ExecContext(phys.conf)
+        rows1 = phys.root.collect(ctx, device=True)
+        assert ctx.catalog.registered_count > 0
+        (ex_stage,) = [st for st in g.stages.values()
+                       if st.boundary is not None]
+        S.invalidate_stage(ctx, ex_stage)
+        assert ctx.catalog.registered_count == 0
+        rows2 = phys.root.collect(ctx, device=True)
+        assert sorted(rows2) == sorted(rows1)
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# lostoutput: recompute only the owning stage (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+class TestLostOutputRecovery:
+    def test_q3_lostoutput_recomputes_only_lost_stage(self, data_dir):
+        free_df = tpch.QUERIES["q3"](_session(), data_dir)
+        free = free_df.collect()
+        free_scans = _scan_batch_counts(free_df)
+
+        df = tpch.QUERIES["q3"](
+            _session("lostoutput@exchange.serve:1"), data_dir)
+        got = df.collect()
+        # Bit-identical to the fault-free run.
+        assert got == free
+        rec = df.metrics()["Recovery@query"]
+        assert rec.get("stageRecomputes") == 1, rec
+        assert faults.counters().get("stageRecomputes") == 1
+        # Only the lost stage's scan re-executed: exactly one scan's
+        # batch counter doubled, the sibling stages' scans are untouched.
+        fault_scans = _scan_batch_counts(df)
+        assert set(fault_scans) == set(free_scans)
+        doubled = [p for p in free_scans
+                   if fault_scans[p] == 2 * free_scans[p]
+                   and free_scans[p] > 0]
+        untouched = [p for p in free_scans
+                     if fault_scans[p] == free_scans[p]]
+        assert len(doubled) == 1 and \
+            len(untouched) == len(free_scans) - 1, \
+            (free_scans, fault_scans)
+
+    def test_lostoutput_checksum_path_counts_in_metrics(self):
+        # Inline 2-stage aggregate: lostoutput on the reduce-side read of
+        # the partial->final exchange recomputes the partial stage only.
+        s = _session("lostoutput@exchange.serve:1")
+        df = s.create_dataframe(
+            {"k": [i % 3 for i in range(24)], "v": list(range(24))},
+            [("k", dt.INT64), ("v", dt.INT64)],
+            num_partitions=2).group_by("k").agg(
+                agg_sum(col("v")).alias("s"))
+        want = sorted(s.create_dataframe(
+            {"k": [i % 3 for i in range(24)], "v": list(range(24))},
+            [("k", dt.INT64), ("v", dt.INT64)]).group_by("k").agg(
+                agg_sum(col("v")).alias("s")).collect_host())
+        assert sorted(df.collect()) == want
+        rec = df.metrics()["Recovery@query"]
+        assert rec.get("stageRecomputes") == 1, rec
+
+    def test_lostoutput_falls_back_to_whole_query_when_disabled(self):
+        s = _session("lostoutput@exchange.serve:1")
+        s.set("spark.rapids.sql.recovery.stageRecompute.enabled", False)
+        df = s.create_dataframe(
+            {"k": [1, 1, 2], "v": [1, 2, 3]},
+            [("k", dt.INT64), ("v", dt.INT64)]).group_by("k").agg(
+                agg_sum(col("v")).alias("s"))
+        assert sorted(df.collect()) == [(1, 3), (2, 3)]
+        c = faults.counters()
+        # The loss carries the UNAVAILABLE marker, so the whole-query
+        # retry recovered it — no stage recompute happened.
+        assert c.get("stageRecomputes", 0) == 0
+        assert c.get("retriesAttempted", 0) >= 1
+
+    def test_repeated_collect_after_recovery_no_refire(self):
+        """Regression (ISSUE 3 satellite): a second collect on the same
+        DataFrame after a fault-recovered first collect is bit-identical
+        and does not re-fire already-consumed count faults."""
+        s = _session("lostoutput@exchange.serve:1")
+        df = s.create_dataframe(
+            {"k": [i % 4 for i in range(32)], "v": list(range(32))},
+            [("k", dt.INT64), ("v", dt.INT64)],
+            num_partitions=2).group_by("k").agg(
+                agg_sum(col("v")).alias("s"))
+        r1 = sorted(df.collect())
+        assert faults.counters().get("stageRecomputes") == 1
+        assert faults.counters().get("faultsInjected") == 1
+        r2 = sorted(df.collect())
+        assert r2 == r1
+        # The consumed schedule stayed consumed: no new injection, no
+        # new recompute, and the second run's metrics are clean.
+        assert faults.counters().get("faultsInjected") == 1
+        assert faults.counters().get("stageRecomputes") == 1
+        rec2 = df.metrics().get("Recovery@query", {})
+        assert rec2.get("stageRecomputes", 0) == 0, rec2
+
+    def test_repeated_collect_after_transient_recovery(self):
+        s = _session("transient@download:1")
+        df = s.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+        r1 = sorted(df.collect())
+        assert r1 == [(1,), (2,), (3,)]
+        assert faults.counters().get("faultsInjected") == 1
+        assert sorted(df.collect()) == r1
+        assert faults.counters().get("faultsInjected") == 1
+
+
+# ---------------------------------------------------------------------------
+# Execution watchdog: stalls killed, partitions re-dispatched
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def _wd_session(self, chaos, timeout_ms=1500, attempts=2):
+        s = _session(chaos)
+        s.set("spark.rapids.sql.watchdog.enabled", True)
+        s.set("spark.rapids.sql.watchdog.taskTimeoutMs", timeout_ms)
+        s.set("spark.rapids.sql.watchdog.maxAttempts", attempts)
+        return s
+
+    def test_stall_killed_and_partition_retry_succeeds(self):
+        s = self._wd_session("stall@upload:1")
+        df = s.create_dataframe({"a": [1, 2, 3]}, [("a", dt.INT64)])
+        assert sorted(df.collect()) == [(1,), (2,), (3,)]
+        c = faults.counters()
+        assert c.get("watchdogKills", 0) >= 1, c
+        assert c.get("partitionRetries", 0) >= 1, c
+        rec = df.metrics()["Recovery@query"]
+        assert rec.get("watchdogKills", 0) >= 1, rec
+
+    def test_watchdog_exhausted_demotes_to_query_retry(self):
+        # Both watchdog attempts stall -> DEADLINE_EXCEEDED -> the
+        # transient rung re-runs the query; the consumed schedule lets
+        # the third execution through (demotion order end-to-end).
+        s = self._wd_session("stall@upload:2", timeout_ms=800)
+        df = s.create_dataframe({"a": [7, 8]}, [("a", dt.INT64)])
+        assert sorted(df.collect()) == [(7,), (8,)]
+        c = faults.counters()
+        assert c.get("watchdogKills", 0) >= 2, c
+        assert c.get("retriesAttempted", 0) >= 1, c
+
+    def test_stall_without_watchdog_is_bounded(self, monkeypatch):
+        # Safety net: with no watchdog armed a stall unwinds as
+        # DEADLINE_EXCEEDED after the bounded nap and the transient
+        # retry recovers the query.
+        monkeypatch.setattr(faults, "STALL_TIMEOUT_S", 0.05)
+        s = _session("stall@upload:1")
+        df = s.create_dataframe({"a": [5]}, [("a", dt.INT64)])
+        assert df.collect() == [(5,)]
+        assert faults.counters().get("retriesAttempted", 0) >= 1
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("qname", ["q6", "q3"])
+    def test_tpch_under_watchdog_stall_lostoutput(self, qname, data_dir):
+        """The CI chaos-matrix entry: TPC-H under the watchdog with a
+        stall + lostoutput schedule, bit-identical to fault-free."""
+        free = tpch.QUERIES[qname](_session(), data_dir).collect()
+        s = self._wd_session(
+            "stall@upload:1,lostoutput@exchange.serve:1",
+            timeout_ms=20000, attempts=2)
+        df = tpch.QUERIES[qname](s, data_dir)
+        assert df.collect() == free
+        c = faults.counters()
+        assert c.get("faultsInjected", 0) >= 2, c
+        assert c.get("watchdogKills", 0) >= 1, c
+        assert c.get("stageRecomputes", 0) >= 1, c
+
+
+# ---------------------------------------------------------------------------
+# Mesh degrade: collective failure demotes to the single-process path
+# ---------------------------------------------------------------------------
+
+class TestMeshDegrade:
+    def _df(self, s):
+        return s.create_dataframe(
+            {"k": [i % 5 for i in range(40)], "v": list(range(40))},
+            [("k", dt.INT64), ("v", dt.INT64)],
+            num_partitions=4).group_by("k").agg(
+                agg_sum(col("v")).alias("s"))
+
+    def test_mesh_collective_failure_degrades_not_dies(self):
+        want = sorted(self._df(_session()).collect())
+        s = _session("transient@mesh.exchange:1")
+        s.set("spark.rapids.sql.mesh.enabled", True)
+        df = self._df(s)
+        assert sorted(df.collect()) == want
+        c = faults.counters()
+        assert c.get("meshDegrades", 0) >= 1, c
+        rec = df.metrics()["Recovery@query"]
+        assert rec.get("meshDegrades", 0) >= 1, rec
+
+    def test_mesh_degrade_disabled_propagates_to_query_retry(self):
+        s = _session("transient@mesh.exchange:1")
+        s.set("spark.rapids.sql.mesh.enabled", True)
+        s.set("spark.rapids.sql.mesh.degrade.enabled", False)
+        df = self._df(s)
+        want = sorted(self._df(_session()).collect())
+        assert sorted(df.collect()) == want
+        c = faults.counters()
+        assert c.get("meshDegrades", 0) == 0, c
+        assert c.get("retriesAttempted", 0) >= 1, c
+
+
+# ---------------------------------------------------------------------------
+# Durable broadcast outputs (satellite: free the device copy on degrade)
+# ---------------------------------------------------------------------------
+
+class TestBroadcastDurableOutput:
+    def _bx(self):
+        from spark_rapids_tpu.parallel.exchange import BroadcastExchangeExec
+        schema = (("a", dt.INT64),)
+        hb = HostBatch.from_pydict(schema, {"a": [1, 2, 3]})
+        return BroadcastExchangeExec(InMemorySourceExec(schema, [[hb]]))
+
+    def test_device_single_is_catalog_registered(self):
+        bx = self._bx()
+        ctx = ExecContext()
+        b = bx.collect_single_device(ctx)
+        assert int(b.live_count()) == 3
+        assert ctx.catalog.registered_count == 1
+        # Re-serving acquires the SAME durable output, not a rebuild.
+        b2 = bx.collect_single_device(ctx)
+        assert ctx.catalog.registered_count == 1
+        assert int(b2.live_count()) == 3
+        ctx.close()
+
+    def test_host_fallback_frees_device_copy(self):
+        bx = self._bx()
+        ctx = ExecContext()
+        bx.collect_single_device(ctx)
+        assert ctx.catalog.registered_count == 1
+        merged = bx.collect_single_host(ctx)
+        assert merged.num_rows == 3
+        # Host degrade of the consuming subtree: the device single is
+        # freed instead of pinning both copies for the query's lifetime.
+        assert bx._cache_key(True) not in ctx.cache
+        assert ctx.catalog.registered_count == 0
+        # A later device consumer transparently rebuilds.
+        bx.collect_single_device(ctx)
+        assert ctx.catalog.registered_count == 1
+        ctx.close()
+
+    def test_stage_invalidate_drops_both_copies(self):
+        bx = self._bx()
+        ctx = ExecContext()
+        bx.collect_single_device(ctx)
+        bx.stage_invalidate(ctx)
+        assert ctx.catalog.registered_count == 0
+        assert bx._cache_key(True) not in ctx.cache
+        ctx.close()
+
+
+# ---------------------------------------------------------------------------
+# Fault-registry hygiene (satellite: snapshot/restore isolation)
+# ---------------------------------------------------------------------------
+
+class TestRegistryIsolation:
+    def test_snapshot_restore_roundtrip(self):
+        state = faults.snapshot()
+        faults.configure("oom@somewhere:3", seed=11)
+        faults.record("somethingOdd", 2)
+        assert faults.injector() is not None
+        faults.restore(state)
+        assert faults.injector() is None          # clean_fault_state disarmed
+        assert "somethingOdd" not in faults.counters()
+
+    def test_armed_schedule_does_not_leak(self):
+        # Arm without cleaning up: the conftest autouse fixture must
+        # restore a clean registry before the NEXT test runs. Paired
+        # with test_snapshot_restore_roundtrip's disarmed assertion,
+        # any leak across tests in this class would trip there.
+        faults.configure("transient@nowhere:5", seed=3)
+        assert faults.injector() is not None
