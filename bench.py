@@ -164,6 +164,7 @@ def main():
     from spark_rapids_tpu.benchmarks import suites, tpch
     from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
     from spark_rapids_tpu.ops import kernel_cache as _kc
+    from spark_rapids_tpu.parallel import pipeline as _pl
 
     sf = float(os.environ.get("TPCH_SF", "1.0"))
     iters = int(os.environ.get("BENCH_ITERS", "2"))
@@ -219,6 +220,11 @@ def main():
         # on a healthy run — nonzero values say the run survived real
         # pressure (or an SRT_FAULTS chaos schedule).
         "recovery": {},
+        # Pipelined-executor counters (parallel/pipeline.py): overlap of
+        # host decode/encode with device dispatch. overlapRatio > 0 says
+        # the overlap is actually happening; 0/absent says the pipeline
+        # degenerated (or SRT_PIPELINE=0).
+        "pipeline": {},
     }
     with _LOCK:
         _STATE["out"] = out
@@ -273,6 +279,7 @@ def main():
                 "misses": kc1["misses"] - kc0["misses"]}
             out["kernel_cache"] = kc1
             out["recovery"] = _faults.counters()
+            out["pipeline"] = _pl.counters()
             out["completed"].append(qn)
             done = out["completed"]
             out["metric"] = f"tpc_sf{sf:g}_suite{len(done)}_wall_clock"
@@ -310,6 +317,12 @@ def main():
                      "partitionRetries", "watchdogKills", "meshDegrades"):
             rec.setdefault(name, 0)
         out["recovery"] = rec
+        pl = _pl.counters()
+        for name in ("hostPrefetchMs", "consumerWaitMs", "pipelineStalls",
+                     "prefetchedPartitions", "concurrentStages",
+                     "overlapRatio"):
+            pl.setdefault(name, 0)
+        out["pipeline"] = pl
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

@@ -474,11 +474,13 @@ class DataFrame:
         level = str(self._session.conf.get(C.METRICS_LEVEL)).upper()
         keep = self._METRIC_LEVELS.get(level)
         # The Recovery@query entry (stageRecomputes, watchdogKills,
-        # meshDegrades, retriesAttempted...) is the fault-tolerance audit
-        # trail — never filtered by verbosity level.
+        # meshDegrades, retriesAttempted...) and the Pipeline@query entry
+        # (hostPrefetchMs, overlapRatio, pipelineStalls,
+        # concurrentStages...) are audit trails — never filtered by
+        # verbosity level.
         return {k: {name: v for name, v in m.values.items()
                     if keep is None or name in keep
-                    or m.owner == "Recovery"}
+                    or m.owner in ("Recovery", "Pipeline")}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

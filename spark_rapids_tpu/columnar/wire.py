@@ -39,6 +39,7 @@ transfers pipeline instead of paying one round trip per buffer.
 from __future__ import annotations
 
 import struct
+import threading
 import zlib
 from typing import List, Optional, Sequence, Tuple
 
@@ -312,7 +313,12 @@ def encode_column(hc, name: str, n: int, cap: int,
     return [data] + varrs, ("num", hc.dtype.name, wire_name, vmode)
 
 
+# (capacity, specs) -> jitted widen. Filled from whichever thread
+# uploads first (concurrent queries / stage threads under the pipelined
+# executor), so insertion is double-checked under a lock — two racing
+# uploads must share ONE compiled program.
 _DECODE_JIT_CACHE: dict = {}
+_DECODE_JIT_LOCK = threading.Lock()
 
 
 def _unpack_validity(bits: jax.Array, cap: int) -> jax.Array:
@@ -422,8 +428,11 @@ def upload_encoded(arrays, specs, n: int, cap: int) -> DeviceBatch:
         key = (cap, specs)
         fn = _DECODE_JIT_CACHE.get(key)
         if fn is None:
-            fn = jax.jit(_decode_fn(cap, specs))
-            _DECODE_JIT_CACHE[key] = fn
+            with _DECODE_JIT_LOCK:
+                fn = _DECODE_JIT_CACHE.get(key)
+                if fn is None:
+                    fn = jax.jit(_decode_fn(cap, specs))
+                    _DECODE_JIT_CACHE[key] = fn
         return fn(dev_arrays, num_rows)
 
     out = retry_on_oom(put_and_decode)

@@ -444,6 +444,48 @@ RECOVERY_MAX_STAGE_RECOMPUTES = conf(
     "losing its output is a sick backend, not a transient blip)."
 ).integer(4)
 
+PIPELINE_ENABLED = conf("spark.rapids.sql.pipeline.enabled").doc(
+    "Pipelined partition execution (parallel/pipeline.py): a host thread "
+    "pool runs the separable host half of each partition (scan-unit "
+    "decode, filter-stat pruning, wire encode) prefetchPartitions ahead "
+    "while the consumer dispatches device work in strict partition order "
+    "under the TPU semaphore — upload of partition p+1 overlaps compute "
+    "of p (the MULTITHREADED-reader overlap, GpuParquetScan.scala:1144, "
+    "applied at every partition-loop dispatch funnel). Independent "
+    "stages of the plan DAG additionally materialize their exchange "
+    "outputs concurrently. Off (or SRT_PIPELINE=0) restores the serial "
+    "per-partition dispatch exactly.").boolean(True)
+
+PIPELINE_PREFETCH_PARTITIONS = conf(
+    "spark.rapids.sql.pipeline.prefetchPartitions").doc(
+    "How many partitions ahead of the ordered consumer the host half may "
+    "run. 1 keeps exactly one partition in flight beyond the one being "
+    "consumed; larger values smooth uneven partition decode times at the "
+    "cost of host memory for the buffered encodes.").integer(2)
+
+PIPELINE_HOST_THREADS = conf("spark.rapids.sql.pipeline.hostThreads").doc(
+    "Host threads shared by the pipeline's partition prefetchers "
+    "(decode + wire encode are pure CPU work; the reference's "
+    "multiThreadedRead.numThreads plays the same role inside one scan)."
+).integer(4)
+
+PIPELINE_MAX_CONCURRENT_STAGES = conf(
+    "spark.rapids.sql.pipeline.maxConcurrentStages").doc(
+    "Upper bound on plan stages (parallel/stages.py DAG nodes) whose "
+    "exchange outputs materialize concurrently — e.g. the build and "
+    "probe side scans of a join. Device dispatch stays bounded by the "
+    "query's TPU semaphore permit; this caps only the thread fan-out. "
+    "1 disables concurrent stage materialization.").integer(2)
+
+KERNEL_CACHE_PERSISTENT_DIR = conf(
+    "spark.rapids.sql.kernelCache.persistentDir").doc(
+    "Directory for JAX's persistent compilation cache: compiled XLA "
+    "executables serialize here and survive process restarts, so a "
+    "fresh process pays deserialization (~ms) instead of recompilation "
+    "(~s) for every kernel it has ever compiled (the first_run_s tax). "
+    "Hits surface as persistentCacheHits in the kernel-cache counters. "
+    "Empty disables.").string("")
+
 MESH_DEGRADE_ENABLED = conf("spark.rapids.sql.mesh.degrade.enabled").doc(
     "Graceful mesh degrade: when a mesh collective exchange fails, "
     "demote this query's exchanges to the single-process "
@@ -556,6 +598,28 @@ def generate_docs() -> str:
         "`kernelCacheHits`/`kernelCacheMisses`/`compileTime` metrics and",
         "fused stages are rendered in `explain`/`pretty_tree` output with",
         "their member operator names.",
+        "",
+        "## Pipelined execution",
+        "",
+        "With `spark.rapids.sql.pipeline.enabled` (default true) every",
+        "partition-loop dispatch funnel (driver collect, exchange",
+        "map-side materialization, broadcast collection) runs through a",
+        "bounded producer/consumer pipeline: a host thread pool",
+        "(`pipeline.hostThreads`) executes the separable host half of",
+        "each partition — scan-unit decode, filter-stat pruning, wire",
+        "encode — up to `pipeline.prefetchPartitions` ahead, while a",
+        "single ordered consumer performs all device dispatch under the",
+        "TPU semaphore. Upload of partition p+1 overlaps compute of p;",
+        "results are deterministically ordered and bit-identical to the",
+        "serial path. Independent plan stages (e.g. the two exchange",
+        "inputs of a shuffled join) additionally materialize",
+        "concurrently, bounded by `pipeline.maxConcurrentStages`.",
+        "`SRT_PIPELINE=0` (or the conf) restores the serial dispatch",
+        "exactly. Overlap is observable via the `Pipeline@query` metrics",
+        "entry and bench.py's `pipeline` JSON block (`hostPrefetchMs`,",
+        "`consumerWaitMs`, `pipelineStalls`, `concurrentStages`,",
+        "`overlapRatio`). See docs/performance.md for the overlap model",
+        "and the interaction with the watchdog/recovery demotion ladder.",
         "",
         "## Robustness: fault injection & the recovery ladder",
         "",

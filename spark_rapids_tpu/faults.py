@@ -28,10 +28,12 @@ env)::
 - ``site``: a named injection point woven into the dispatch funnels:
   ``upload`` (wire codec device_put), ``download`` (result device_get),
   ``concat`` (batch coalescing), ``kernel`` (cached-kernel dispatch),
-  ``exchange.flush`` / ``exchange.serve`` (shuffle map/reduce sides),
-  ``mesh.exchange`` (collective shuffle), ``spill.write`` /
-  ``spill.read`` (disk tier I/O), ``wire`` (serialized spill frames —
-  corrupt only).
+  ``scan`` (host-side scan-unit decode — fires on prefetch/reader
+  threads and is re-raised at the ordered consumption point under the
+  pipelined executor), ``exchange.flush`` / ``exchange.serve`` (shuffle
+  map/reduce sides), ``mesh.exchange`` (collective shuffle),
+  ``spill.write`` / ``spill.read`` (disk tier I/O), ``wire``
+  (serialized spill frames — corrupt only).
 - ``arg``: an integer N fires on the first N hits of the site (default
   1); a float p in (0, 1) fires per-hit with probability p from a
   deterministic per-site PRNG seeded by
@@ -312,6 +314,15 @@ def set_cancel_event(event) -> None:
     :class:`InjectedStallError` the moment the watchdog kills the
     attempt, so the abandoned thread exits instead of lingering."""
     _TL.cancel = event
+
+
+def get_cancel_event():
+    """The calling thread's registered cancel event (None outside a
+    watchdog attempt). Pool fan-outs that dispatch work on helper
+    threads (scan reader pool, pipeline prefetchers) propagate it so a
+    stall on a helper thread still unwinds when the watchdog kills the
+    consuming attempt."""
+    return getattr(_TL, "cancel", None)
 
 
 def record(name: str, amount: float = 1) -> None:

@@ -31,6 +31,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import os
+import threading
 from collections import OrderedDict
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -94,21 +95,25 @@ class ScanUnit:
 # repeated across planning + N partitions, so memoize. Bounded: inserting a
 # new entry evicts stale entries for the same path (rewritten files), and
 # the whole cache is FIFO-capped so long sessions don't leak FileMetaData.
+# Locked: pipeline prefetch threads probe partitions concurrently.
 _PQ_META_CACHE: Dict[Tuple[str, float, int], Any] = {}
 _PQ_META_CACHE_MAX = 1024
+_PQ_META_LOCK = threading.Lock()
 
 
 def _parquet_metadata(path: str):
     st = os.stat(path)
     key = (path, st.st_mtime, st.st_size)
-    md = _PQ_META_CACHE.get(key)
+    with _PQ_META_LOCK:
+        md = _PQ_META_CACHE.get(key)
     if md is None:
         md = papq.ParquetFile(path).metadata
-        for stale in [k for k in _PQ_META_CACHE if k[0] == path]:
-            del _PQ_META_CACHE[stale]
-        while len(_PQ_META_CACHE) >= _PQ_META_CACHE_MAX:
-            _PQ_META_CACHE.pop(next(iter(_PQ_META_CACHE)))
-        _PQ_META_CACHE[key] = md
+        with _PQ_META_LOCK:
+            for stale in [k for k in _PQ_META_CACHE if k[0] == path]:
+                del _PQ_META_CACHE[stale]
+            while len(_PQ_META_CACHE) >= _PQ_META_CACHE_MAX:
+                _PQ_META_CACHE.pop(next(iter(_PQ_META_CACHE)))
+            _PQ_META_CACHE[key] = md
     return md
 
 
@@ -140,6 +145,7 @@ def enumerate_units(fmt: str, paths: Sequence[str]) -> List[ScanUnit]:
 # of FIFO-evicting the entries the workload keeps probing.
 _ORC_STATS_CACHE: "OrderedDict[Tuple, Dict[str, tuple]]" = OrderedDict()
 _ORC_STATS_CACHE_MAX = 4096
+_ORC_STATS_LOCK = threading.Lock()
 
 
 class _Stat:
@@ -154,12 +160,16 @@ class _Stat:
 def _orc_stripe_stats(unit: ScanUnit, names: Sequence[str]
                       ) -> Tuple[Dict[str, "_Stat"], int]:
     """(per-column stats, stripe row count). Columns missing from the
-    file cache a no-stats sentinel so they are never re-probed."""
+    file cache a no-stats sentinel so they are never re-probed.
+    Serialized by a lock: pipeline prefetch threads prune partitions
+    concurrently and an OrderedDict must never interleave mutations."""
     st = os.stat(unit.path)
     key = (unit.path, st.st_mtime, st.st_size, unit.index)
-    cached = _ORC_STATS_CACHE.get(key)
-    if cached is not None:
-        _ORC_STATS_CACHE.move_to_end(key)
+    with _ORC_STATS_LOCK:
+        cached = _ORC_STATS_CACHE.get(key)
+        if cached is not None:
+            _ORC_STATS_CACHE.move_to_end(key)
+            cached = dict(cached)
     need = [n for n in names
             if cached is None or n not in cached]
     if need:
@@ -181,13 +191,20 @@ def _orc_stripe_stats(unit: ScanUnit, names: Sequence[str]
         for n in need:
             if n not in entry:      # absent column: unknown-stats marker
                 entry[n] = (None, None, None, -1)
-        if key not in _ORC_STATS_CACHE:
-            # Evict only for a genuinely new key (an update of a resident
-            # key must never push out a warm neighbor), oldest first.
-            while len(_ORC_STATS_CACHE) >= _ORC_STATS_CACHE_MAX:
-                _ORC_STATS_CACHE.popitem(last=False)
-        _ORC_STATS_CACHE[key] = entry
-        _ORC_STATS_CACHE.move_to_end(key)
+        with _ORC_STATS_LOCK:
+            resident = _ORC_STATS_CACHE.get(key)
+            if resident is not None:
+                # A concurrent prober filled other columns meanwhile:
+                # merge instead of clobbering its work.
+                entry = {**resident, **entry}
+            elif key not in _ORC_STATS_CACHE:
+                # Evict only for a genuinely new key (an update of a
+                # resident key must never push out a warm neighbor),
+                # oldest first.
+                while len(_ORC_STATS_CACHE) >= _ORC_STATS_CACHE_MAX:
+                    _ORC_STATS_CACHE.popitem(last=False)
+            _ORC_STATS_CACHE[key] = entry
+            _ORC_STATS_CACHE.move_to_end(key)
         cached = entry
     num_rows = max((rows for (_, _, _, rows) in cached.values()
                     if rows >= 0), default=0)
@@ -300,29 +317,37 @@ class DeviceScanCache:
         self._entries: "dict" = {}     # key -> [DeviceBatch]
         self._bytes: Dict[Any, int] = {}
         self._total = 0
+        # Probed/filled from pipeline prefetch threads and concurrent
+        # consumers: LRU reorder + eviction accounting must be atomic.
+        self._lock = threading.Lock()
 
     def get(self, key):
-        e = self._entries.pop(key, None)
-        if e is not None:
-            self._entries[key] = e     # move to MRU position
-        return e
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is not None:
+                self._entries[key] = e     # move to MRU position
+            return e
 
     def put(self, key, batches, budget: int):
         size = sum(b.device_size_bytes() for b in batches)
         if size > budget:
             return
-        while self._total + size > budget and self._entries:
-            old_key = next(iter(self._entries))
-            self._entries.pop(old_key)
-            self._total -= self._bytes.pop(old_key)
-        self._entries[key] = list(batches)
-        self._bytes[key] = size
-        self._total += size
+        with self._lock:
+            if key in self._entries:
+                return                     # concurrent filler won
+            while self._total + size > budget and self._entries:
+                old_key = next(iter(self._entries))
+                self._entries.pop(old_key)
+                self._total -= self._bytes.pop(old_key)
+            self._entries[key] = list(batches)
+            self._bytes[key] = size
+            self._total += size
 
     def clear(self):
-        self._entries.clear()
-        self._bytes.clear()
-        self._total = 0
+        with self._lock:
+            self._entries.clear()
+            self._bytes.clear()
+            self._total = 0
 
 
 DEVICE_SCAN_CACHE = DeviceScanCache()
@@ -407,6 +432,97 @@ class FileScanExec(LeafExec):
             yield from _read_unit_batches(self.fmt, unit, self.options,
                                           rows, self._columns)
 
+    # -- pipelined prefetch (parallel/pipeline.py) ---------------------------
+    def host_prefetchable(self) -> bool:
+        return True
+
+    def _prefetch_key(self, partition: int) -> str:
+        return f"scan-prefetch:{id(self):x}:{partition}"
+
+    def prefetch_host(self, ctx, partition) -> None:
+        """The separable host half of one partition: stats pruning, unit
+        decode and wire encode — everything before ``device_put``. Runs
+        on a pipeline prefetch thread; the payload lands in ``ctx.cache``
+        and the ordered consumer's :meth:`execute_device` pops it and
+        only uploads. Payload entries are ``(unit, encodes)`` /
+        ``(unit, "cached")`` for device-cache hits / ``(None, encodes)``
+        for COALESCING merges (which have no per-unit identity)."""
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.columnar import wire
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        m = ctx.metrics_for(self)
+        rt = self._reader_type(ctx)
+        rows = self._batch_rows(ctx)
+        units = self._units_of(partition, m)
+        budget = int(ctx.conf.get(C.SCAN_CACHE_BYTES))
+        use_cache = budget > 0 and rt != "COALESCING"
+        payload: List[tuple] = []
+        if rt == "COALESCING":
+            pending: List[HostBatch] = []
+            pending_rows = 0
+            for unit in units:
+                faults.fault_point("scan")
+                for hb in _read_unit_batches(self.fmt, unit, self.options,
+                                             rows, self._columns):
+                    pending.append(hb)
+                    pending_rows += hb.num_rows
+                    if pending_rows >= rows:
+                        payload.append((None, [wire.encode_batch(
+                            concat_host_batches(pending))]))
+                        pending, pending_rows = [], 0
+            if pending:
+                payload.append((None, [wire.encode_batch(
+                    concat_host_batches(pending))]))
+        else:
+            for unit in units:
+                if use_cache and DEVICE_SCAN_CACHE.get(
+                        self._unit_cache_key(unit, rows)) is not None:
+                    payload.append((unit, "cached"))
+                    continue
+                faults.fault_point("scan")
+                payload.append((unit, [
+                    wire.encode_batch(hb)
+                    for hb in _read_unit_batches(self.fmt, unit,
+                                                 self.options, rows,
+                                                 self._columns)]))
+        ctx.cache[self._prefetch_key(partition)] = payload
+
+    def _device_prefetched(self, ctx, m, payload, rows, partition,
+                           budget):
+        """Consume a prefetched partition: upload-only, in payload order
+        (identical to the serial decode order, so results match the
+        serial path bit-for-bit)."""
+        from spark_rapids_tpu.columnar import wire
+        for unit, item in payload:
+            if unit is not None and item == "cached":
+                hit = DEVICE_SCAN_CACHE.get(
+                    self._unit_cache_key(unit, rows)) \
+                    if budget > 0 else None
+                if hit is not None:
+                    m.add("scanCacheHits", 1)
+                    self._publish_input_file(ctx, partition, unit.path)
+                    for b in hit:
+                        m.add("numOutputBatches", 1)
+                        yield b
+                else:
+                    # Evicted between prefetch and consume: decode inline.
+                    yield from self._device_perfile(ctx, m, [unit], rows,
+                                                    partition, budget)
+                continue
+            if unit is not None:
+                self._publish_input_file(ctx, partition, unit.path)
+            ubatches = []
+            for enc in item:
+                with timed(m, "bufferTime"):
+                    batch = wire.upload_encoded(*enc)
+                m.add("numOutputBatches", 1)
+                ubatches.append(batch)
+                yield batch
+            if unit is not None and budget > 0:
+                key = self._unit_cache_key(unit, rows)
+                if key is not None:
+                    DEVICE_SCAN_CACHE.put(key, ubatches, budget)
+
     # -- device engine -------------------------------------------------------
     def _unit_cache_key(self, unit: ScanUnit, rows: int):
         try:
@@ -423,6 +539,16 @@ class FileScanExec(LeafExec):
         m = ctx.metrics_for(self)
         rt = self._reader_type(ctx)
         rows = self._batch_rows(ctx)
+        pre = ctx.cache.pop(self._prefetch_key(partition), None)
+        if pre is not None:
+            # Pipeline prefetch already decoded+encoded this partition on
+            # a host thread; this (ordered, single-consumer) call only
+            # uploads. A watchdog-killed attempt popped the payload with
+            # it, so a re-dispatch falls through to the inline path.
+            yield from self._device_prefetched(
+                ctx, m, pre, rows, partition,
+                int(ctx.conf.get(C.SCAN_CACHE_BYTES)))
+            return
         units = self._units_of(partition, m)
         budget = int(ctx.conf.get(C.SCAN_CACHE_BYTES))
         # COALESCING merges units into one upload, so its outputs have no
@@ -460,7 +586,9 @@ class FileScanExec(LeafExec):
             yield from read(ctx, m, run, rows, partition, budget)
 
     def _device_perfile(self, ctx, m, units, rows, partition, budget):
+        from spark_rapids_tpu import faults
         for unit in units:
+            faults.fault_point("scan")
             self._publish_input_file(ctx, partition, unit.path)
             ubatches = []
             for hb in _read_unit_batches(self.fmt, unit, self.options,
@@ -483,20 +611,35 @@ class FileScanExec(LeafExec):
         are in flight at once and each finished unit's batches are yielded
         (uploaded) while later units keep decoding in the background —
         never the old whole-partition ``list(...)`` buffering."""
+        from spark_rapids_tpu import faults
         nthreads = int(ctx.conf.get(
             C.PARQUET_MULTITHREADED_READ_NUM_THREADS))
         if not units:
             return
         window = min(nthreads, len(units))
+        # Worker threads inherit this (consuming) thread's recovery sink
+        # and watchdog cancel event, so injected faults on the pool count
+        # into the query's Recovery metrics and a stalled decode unwinds
+        # the moment the watchdog kills the consuming attempt.
+        sink = faults.get_recovery_sink()
+        cancel = faults.get_cancel_event()
 
         def read_unit(u):
             # Decode AND wire-encode in the worker: the upload's host half
             # (narrowing analysis, padding, bit-packing) is CPU work that
             # overlaps with device consumption of earlier units.
             from spark_rapids_tpu.columnar import wire
-            return [wire.encode_batch(hb)
-                    for hb in _read_unit_batches(self.fmt, u, self.options,
-                                                 rows, self._columns)]
+            faults.set_recovery_sink(sink)
+            faults.set_cancel_event(cancel)
+            try:
+                faults.fault_point("scan")
+                return [wire.encode_batch(hb)
+                        for hb in _read_unit_batches(self.fmt, u,
+                                                     self.options, rows,
+                                                     self._columns)]
+            finally:
+                faults.set_cancel_event(None)
+                faults.set_recovery_sink(None)
 
         from spark_rapids_tpu.columnar import wire
         with concurrent.futures.ThreadPoolExecutor(
@@ -529,9 +672,11 @@ class FileScanExec(LeafExec):
     def _device_coalescing(self, ctx, m, units, rows):
         """Concatenate small units' rows into fewer, larger uploads
         (MultiFileParquetPartitionReader:823 stitch idea)."""
+        from spark_rapids_tpu import faults
         pending: List[HostBatch] = []
         pending_rows = 0
         for unit in units:
+            faults.fault_point("scan")
             for hb in _read_unit_batches(self.fmt, unit, self.options,
                                          rows, self._columns):
                 pending.append(hb)

@@ -17,6 +17,7 @@ numOutputBatches, totalTime (ns).
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -38,9 +39,14 @@ class Metrics:
     def __init__(self, owner: str = ""):
         self.owner = owner
         self.values: Dict[str, float] = {}
+        # add() is a read-modify-write reached from prefetch/stage
+        # threads under the pipelined executor — lock it so two
+        # concurrent collects can never lose counter increments.
+        self._lock = threading.Lock()
 
     def add(self, name: str, amount: float):
-        self.values[name] = self.values.get(name, 0) + amount
+        with self._lock:
+            self.values[name] = self.values.get(name, 0) + amount
 
     def __repr__(self):  # pragma: no cover - cosmetic
         return f"Metrics({self.values})"
@@ -57,44 +63,57 @@ class ExecContext:
     metrics: Dict[str, Metrics] = dataclasses.field(default_factory=dict)
     cache: Dict[str, object] = dataclasses.field(default_factory=dict)
     _catalog: Optional[object] = None
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False)
 
     def metrics_for(self, op: "Exec") -> Metrics:
         # Keyed/owned by op.name (not the bare class name) so fused
         # stages report as FusedStageExec[Project->Filter->...] and the
-        # per-node metrics owner stays readable after fusion.
+        # per-node metrics owner stays readable after fusion. Locked:
+        # concurrent stage/prefetch threads registering the same op must
+        # share ONE Metrics object (a lost entry loses its counts).
         key = f"{op.name}@{id(op):x}"
-        if key not in self.metrics:
-            self.metrics[key] = Metrics(owner=op.name)
-        return self.metrics[key]
+        m = self.metrics.get(key)
+        if m is None:
+            with self._lock:
+                m = self.metrics.get(key)
+                if m is None:
+                    m = self.metrics[key] = Metrics(owner=op.name)
+        return m
 
     @property
     def catalog(self):
         """Lazily-built spill catalog: every held batch (shuffle buckets,
         broadcast tables, buffered build sides) registers here so HBM
         pressure spills device->host->disk instead of OOMing
-        (RapidsBufferCatalog.init wiring, RapidsBufferCatalog.scala:128)."""
+        (RapidsBufferCatalog.init wiring, RapidsBufferCatalog.scala:128).
+        Built under the context lock: concurrent stage threads must
+        never race two catalogs into existence (one would leak)."""
         if self._catalog is None:
-            from spark_rapids_tpu import config as C
-            from spark_rapids_tpu.memory.stores import BufferCatalog
-            budget = int(self.conf.get(C.DEVICE_BUDGET_BYTES))
-            if budget <= 0:
-                visible = _visible_device_bytes()
-                budget = int(visible
-                             * float(self.conf.get(C.HBM_POOL_FRACTION)))
-                # Ceiling + runtime reserve (maxAllocFraction / reserve,
-                # RapidsConf's RMM pool bounds).
-                ceiling = int(visible * float(
-                    self.conf.get(C.MAX_ALLOC_FRACTION))) \
-                    - int(self.conf.get(C.RESERVE_BYTES))
-                budget = max(min(budget, ceiling), 1 << 20)
-            self._catalog = BufferCatalog(
-                device_budget_bytes=budget,
-                host_budget_bytes=int(
-                    self.conf.get(C.HOST_SPILL_STORAGE_SIZE)),
-                spill_dir=str(self.conf.get(C.SPILL_DIR)),
-                compression_codec=str(
-                    self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)),
-                debug=bool(self.conf.get(C.MEMORY_DEBUG)))
+            with self._lock:
+                if self._catalog is not None:
+                    return self._catalog
+                from spark_rapids_tpu import config as C
+                from spark_rapids_tpu.memory.stores import BufferCatalog
+                budget = int(self.conf.get(C.DEVICE_BUDGET_BYTES))
+                if budget <= 0:
+                    visible = _visible_device_bytes()
+                    budget = int(visible * float(
+                        self.conf.get(C.HBM_POOL_FRACTION)))
+                    # Ceiling + runtime reserve (maxAllocFraction /
+                    # reserve, RapidsConf's RMM pool bounds).
+                    ceiling = int(visible * float(
+                        self.conf.get(C.MAX_ALLOC_FRACTION))) \
+                        - int(self.conf.get(C.RESERVE_BYTES))
+                    budget = max(min(budget, ceiling), 1 << 20)
+                self._catalog = BufferCatalog(
+                    device_budget_bytes=budget,
+                    host_budget_bytes=int(
+                        self.conf.get(C.HOST_SPILL_STORAGE_SIZE)),
+                    spill_dir=str(self.conf.get(C.SPILL_DIR)),
+                    compression_codec=str(
+                        self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)),
+                    debug=bool(self.conf.get(C.MEMORY_DEBUG)))
         return self._catalog
 
     def close(self):
@@ -176,6 +195,29 @@ class Exec:
     def execute_host(self, ctx: ExecContext,
                      partition: int) -> Iterator[HostBatch]:
         raise NotImplementedError
+
+    # -- pipelined execution (parallel/pipeline.py) --------------------------
+    def host_prefetchable(self) -> bool:
+        """True when this subtree exposes a separable host half worth
+        prefetching (a scan below, without crossing a stage boundary —
+        a boundary exchange pipelines its own materialization loop)."""
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        return any(c.host_prefetchable() for c in self.children
+                   if not is_stage_boundary(c))
+
+    def prefetch_host(self, ctx: ExecContext, partition: int) -> None:
+        """Run the host half of ``partition`` ahead of device dispatch
+        (decode, stats pruning, wire encode — everything before
+        ``device_put``). Called on pipeline prefetch threads; the
+        results land in ``ctx.cache`` keyed by (node, partition) and the
+        ordered consumer's ``execute_device`` pops them, so a mistimed
+        or never-consumed prefetch costs only wasted CPU, never wrong
+        rows. Recursion stops at stage boundaries: partition numbering
+        changes there, and the boundary pipelines its own loop."""
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        for c in self.children:
+            if not is_stage_boundary(c):
+                c.prefetch_host(ctx, partition)
 
     # -- recovery ------------------------------------------------------------
     def execute_device_recovering(self, ctx: ExecContext,
@@ -330,26 +372,50 @@ class Exec:
                 set_active_catalog(ctx.catalog)
                 faults.set_recovery_sink(self._recovery_metrics(ctx))
                 try:
+                    from spark_rapids_tpu.parallel import pipeline as PL
+                    # Independent stages (join build/probe sides...)
+                    # materialize their exchange outputs concurrently
+                    # before the ordered partition loop; a no-op when
+                    # the pipeline is off or the plan is single-stage.
+                    PL.prematerialize_stages(ctx, self)
                     wd = _watchdog_params(ctx.conf)
                     batches: List[DeviceBatch] = []
                     if wd is None:
-                        for p in range(self.num_partitions(ctx)):
-                            batches.extend(
-                                self.execute_device_recovering(ctx, p))
+                        nparts = self.num_partitions(ctx)
+                        pipe = PL.open_pipeline(ctx, self, nparts)
+                        try:
+                            for p in range(nparts):
+                                # consume() waits for p's host half then
+                                # returns the device stream verbatim, so
+                                # the serial path keeps streaming exactly
+                                # as before.
+                                batches.extend(pipe.consume(
+                                    p, lambda p=p:
+                                    self.execute_device_recovering(
+                                        ctx, p)))
+                        finally:
+                            pipe.close()
                     else:
                         # The partition count itself can trigger device
                         # work (AQE coalescing materializes the exchange
                         # to learn exact bucket sizes), so it runs under
-                        # the watchdog too.
+                        # the watchdog too; the pipeline's per-partition
+                        # wait then happens INSIDE the watchdog deadline
+                        # (a stalled prefetch is killed with the attempt).
                         nparts = self._watchdog_run(
                             ctx, wd, "partition-count",
                             lambda: self.num_partitions(ctx))
-                        for p in range(nparts):
-                            batches.extend(self._watchdog_run(
-                                ctx, wd, f"partition {p}",
-                                lambda p=p: list(
-                                    self.execute_device_recovering(
-                                        ctx, p))))
+                        pipe = PL.open_pipeline(ctx, self, nparts)
+                        try:
+                            for p in range(nparts):
+                                batches.extend(self._watchdog_run(
+                                    ctx, wd, f"partition {p}",
+                                    lambda p=p: pipe.consume(
+                                        p, lambda: list(
+                                            self.execute_device_recovering(
+                                                ctx, p)))))
+                        finally:
+                            pipe.close()
                     host_batches = download_batches(batches, names)
                 finally:
                     set_active_catalog(None)

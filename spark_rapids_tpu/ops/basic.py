@@ -260,6 +260,23 @@ class UnionExec(Exec):
         child, p = self._locate(ctx, partition)
         yield from child.execute_host(ctx, p)
 
+    def prefetch_host(self, ctx, partition):
+        # Union concatenates child partition spaces, so the prefetch must
+        # translate the partition index before descending. Subtrees that
+        # contain a stage boundary are skipped entirely: _locate's
+        # num_partitions probe could otherwise trigger an exchange
+        # materialization (AQE sizing) on a prefetch thread.
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+
+        def boundary_free(op):
+            return not is_stage_boundary(op) and \
+                all(boundary_free(c) for c in op.children)
+
+        if not all(boundary_free(c) for c in self.children):
+            return
+        child, p = self._locate(ctx, partition)
+        child.prefetch_host(ctx, p)
+
 
 class CoalescePartitionsExec(Exec):
     """Reduce partition count by concatenating streams (GpuCoalesceExec)."""

@@ -736,6 +736,25 @@ class BroadcastHashJoinExec(ShuffledHashJoinExec):
             self.children[1]
         return probe.num_partitions(ctx)
 
+    def _probe_child(self):
+        return self.children[0] if self.join_type != "right" else \
+            self.children[1]
+
+    def host_prefetchable(self) -> bool:
+        # Only the PROBE side streams by this node's partition numbering;
+        # the build side materializes once (builtside cache) — prefetching
+        # it per probe partition would re-encode the whole build table
+        # N times for nothing.
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        probe = self._probe_child()
+        return not is_stage_boundary(probe) and probe.host_prefetchable()
+
+    def prefetch_host(self, ctx, partition):
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        probe = self._probe_child()
+        if not is_stage_boundary(probe):
+            probe.prefetch_host(ctx, partition)
+
     def execute_device(self, ctx, partition):
         build_right = self.join_type != "right"
         build_child = self.children[1] if build_right else self.children[0]
@@ -803,6 +822,18 @@ class BroadcastNestedLoopJoinExec(Exec, _JoinKernelMixin):
 
     def num_partitions(self, ctx) -> int:
         return self.children[0].num_partitions(ctx)
+
+    def host_prefetchable(self) -> bool:
+        # Probe (left) side only — the broadcast build side is pulled
+        # whole per partition, not by this node's partition numbering.
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        return not is_stage_boundary(self.children[0]) and \
+            self.children[0].host_prefetchable()
+
+    def prefetch_host(self, ctx, partition):
+        from spark_rapids_tpu.parallel.stages import is_stage_boundary
+        if not is_stage_boundary(self.children[0]):
+            self.children[0].prefetch_host(ctx, partition)
 
     def execute_device(self, ctx, partition):
         jt = self.join_type

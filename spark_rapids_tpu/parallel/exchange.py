@@ -304,13 +304,25 @@ class ShuffleExchangeExec(Exec):
         max_window_bytes = max(ctx.catalog.device_budget // 4, 1 << 20)
         window: List[DeviceBatch] = []
         window_bytes = 0
+        # Map-side partition loop through the pipelined executor: the
+        # child's host half (scan decode + wire encode) runs
+        # prefetchPartitions ahead on host threads while THIS (single,
+        # ordered) consumer uploads and splits — the overlap that makes
+        # scans below an exchange pipeline (parallel/pipeline.py). The
+        # serial pipeline is a no-op passthrough, streaming exactly as
+        # before.
+        from spark_rapids_tpu.parallel import pipeline as PL
+        nchild = self.children[0].num_partitions(ctx)
+        pipe = PL.open_pipeline(ctx, self.children[0], nchild)
         try:
-            for cp in range(self.children[0].num_partitions(ctx)):
+            for cp in range(nchild):
                 # Child pull through the recovery wrapper: an
                 # OOM-exhausted child subtree degrades to the host engine
                 # per operator instead of failing the exchange.
-                for b in self.children[0].execute_device_recovering(ctx,
-                                                                    cp):
+                for b in pipe.consume(
+                        cp, lambda cp=cp:
+                        self.children[0].execute_device_recovering(
+                            ctx, cp)):
                     window.append(b)
                     window_bytes += b.device_size_bytes()
                     if len(window) >= _WINDOW or \
@@ -329,6 +341,8 @@ class ShuffleExchangeExec(Exec):
                 for sb in blist:
                     sb.close()
             raise
+        finally:
+            pipe.close()
         ctx.cache[key] = buckets
         ctx.cache[key + ":rows"] = bucket_rows
         return buckets
@@ -439,6 +453,14 @@ class ShuffleExchangeExec(Exec):
         buckets = self._materialize_host(ctx)
         yield from iter(buckets[partition])
 
+    # -- pipelined execution -------------------------------------------------
+    def stage_prematerialize(self, ctx) -> None:
+        """Materialize this stage's durable output now (idempotent vs
+        the context cache) — the hook parallel/pipeline.py uses to run
+        independent sibling stages concurrently."""
+        if ctx.cache.get("engine") == "device":
+            self._materialize_device(ctx)
+
     # -- lineage recovery ----------------------------------------------------
     def stage_invalidate(self, ctx) -> None:
         """Drop this exchange's durable stage output (parallel/stages.py
@@ -487,10 +509,17 @@ class BroadcastExchangeExec(Exec):
             batch = handle.get()
             handle.release(PRIORITY_BROADCAST)
             return batch
+        from spark_rapids_tpu.parallel import pipeline as PL
+        nchild = self.children[0].num_partitions(ctx)
+        pipe = PL.open_pipeline(ctx, self.children[0], nchild)
         batches = []
-        for cp in range(self.children[0].num_partitions(ctx)):
-            batches.extend(
-                self.children[0].execute_device_recovering(ctx, cp))
+        try:
+            for cp in range(nchild):
+                batches.extend(pipe.consume(
+                    cp, lambda cp=cp:
+                    self.children[0].execute_device_recovering(ctx, cp)))
+        finally:
+            pipe.close()
         if not batches:
             raise ValueError("broadcast of empty child needs a schema batch")
         # One batched sizes pull, then shrink members to live scale: the
@@ -530,6 +559,12 @@ class BroadcastExchangeExec(Exec):
         if dev is not None:
             dev.close()
         return merged
+
+    def stage_prematerialize(self, ctx) -> None:
+        """Build the broadcast single now (idempotent) so sibling stages
+        can materialize concurrently (parallel/pipeline.py)."""
+        if ctx.cache.get("engine") == "device":
+            self.collect_single_device(ctx)
 
     def stage_invalidate(self, ctx) -> None:
         """Drop the broadcast's durable output (stage boundary contract,

@@ -24,6 +24,13 @@ two pieces:
    recovery: only the lost stage (and the never-materialized result
    stage above it) re-executes; sibling stages' scans never run again.
 
+The same DAG also powers the pipelined executor (parallel/pipeline.py,
+ISSUE 4): stages whose parents are all materialized are *independent*,
+so their boundary exchanges' ``stage_prematerialize`` hooks run
+concurrently (the build- and probe-side scans of a shuffled join
+materialize in parallel), bounded by
+``spark.rapids.sql.pipeline.maxConcurrentStages``.
+
 The planner's retry ladder (plan/planner.py) demotes through:
 watchdog partition retry (ops/base.py) -> stage recompute (this module)
 -> whole-query retry on a fresh context (only when the loss cannot be

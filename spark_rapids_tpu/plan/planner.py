@@ -568,6 +568,12 @@ class Planner:
         from spark_rapids_tpu.ops import kernel_cache
         kernel_cache.cache().configure(
             int(self.conf.get(C.KERNEL_CACHE_MAX_ENTRIES)))
+        # Persistent (on-disk) compilation cache: compiled executables
+        # survive process restarts, so first_run_s pays deserialization
+        # instead of recompilation (idempotent; first configured dir of
+        # the process wins).
+        kernel_cache.configure_persistent(
+            str(self.conf.get(C.KERNEL_CACHE_PERSISTENT_DIR) or ""))
         num_fused = 0
         if bool(self.conf.get(C.STAGE_FUSION_ENABLED)):
             from spark_rapids_tpu.plan.fusion import fuse_stages

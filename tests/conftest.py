@@ -34,6 +34,15 @@ if os.environ.get("SRT_STAGE_FUSION") == "0":
     from spark_rapids_tpu import config as _C  # noqa: E402
     _C.STAGE_FUSION_ENABLED.default = False
 
+# SRT_PIPELINE=0 is additionally honored dynamically by
+# parallel/pipeline.py (params_of) — every suite must pass with the
+# serial dispatch path. SRT_PIPELINE_PREFETCH overrides the default
+# prefetch depth (the CI matrix runs prefetchPartitions=1 vs default).
+if os.environ.get("SRT_PIPELINE_PREFETCH"):
+    from spark_rapids_tpu import config as _C2  # noqa: E402
+    _C2.PIPELINE_PREFETCH_PARTITIONS.default = int(
+        os.environ["SRT_PIPELINE_PREFETCH"])
+
 
 @pytest.fixture
 def rng():

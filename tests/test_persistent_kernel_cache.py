@@ -1,0 +1,88 @@
+"""Persistent compilation cache (ISSUE 4 satellite): compiled XLA
+executables behind ``spark.rapids.sql.kernelCache.persistentDir``
+serialize to disk and are served back (persistentCacheHits) after the
+in-memory caches are dropped — the in-process proxy for surviving a
+process restart (first_run_s -> steady state).
+
+JAX's compilation-cache dir is process-global and STICKY once set — a
+test that enabled it would tax every later compile of the pytest
+process with disk serialization. The enable-and-hit scenario therefore
+runs in a throwaway subprocess; only side-effect-free pieces run
+in-process.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from spark_rapids_tpu.ops import kernel_cache as kc
+
+
+def test_empty_dir_never_enables():
+    assert not kc.configure_persistent("")
+    assert not kc.configure_persistent(None)
+    assert kc.persistent_stats()["dir"] is None
+
+
+_SUBPROCESS_BODY = r"""
+import glob, os, sys, tempfile
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+import jax._src.xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from spark_rapids_tpu.ops import kernel_cache as kc
+
+d = tempfile.mkdtemp()
+# Compile BEFORE enabling: proves configure_persistent resets jax's
+# "cache usable" latch instead of requiring process-start configuration.
+jax.jit(lambda x: x + 1)(jnp.arange(4)).block_until_ready()
+
+assert kc.configure_persistent(d), "enable failed"
+assert kc.configure_persistent(d), "not idempotent"
+s = kc.cache().stats()
+assert s.get("persistentCacheDir") == d, s
+assert "persistentCacheHits" in s and "persistentCacheMisses" in s, s
+
+f = jax.jit(lambda x: x * 3 + 1)
+f(jnp.arange(16)).block_until_ready()
+files = glob.glob(os.path.join(d, "*"))
+assert files, "persistent cache wrote nothing"
+
+before = kc.persistent_stats()["hits"]
+# Drop jax's in-memory executable caches: the SAME computation must now
+# come back from disk (what a restarted process would do).
+jax.clear_caches()
+g = jax.jit(lambda x: x * 3 + 1)
+g(jnp.arange(16)).block_until_ready()
+after = kc.persistent_stats()["hits"]
+assert after > before, (before, after)
+
+# The session conf wires through the planner.
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.plan.logical import col
+s = TpuSession()
+s.set("spark.rapids.sql.kernelCache.persistentDir", d)
+df = s.create_dataframe({"a": [1, 2, 3]}, (("a", dt.INT64),))
+assert df.select((col("a") * 2).alias("b")).collect() == \
+    [(2,), (4,), (6,)]
+assert kc.persistent_stats()["dir"] == d
+print("PERSISTENT_CACHE_OK")
+"""
+
+
+def test_enable_write_and_hit_in_subprocess():
+    # Bounded (~20s: one jax import + a handful of tiny compiles) and
+    # fully isolated — the sticky global cache dies with the subprocess.
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _SUBPROCESS_BODY],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "PERSISTENT_CACHE_OK" in out.stdout

@@ -1,0 +1,176 @@
+"""Thread-safety audit regressions (ISSUE 4 satellite): the kernel
+cache, the metrics dicts, and the scan-side caches under concurrent
+collects — two queries pipelining simultaneously must not corrupt LRU
+order or counter totals.
+"""
+
+import threading
+
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.ops import kernel_cache as kc
+from spark_rapids_tpu.ops.base import Metrics
+
+
+# ---------------------------------------------------------------------------
+# KernelCache under contention
+# ---------------------------------------------------------------------------
+
+def test_kernel_cache_concurrent_lookups_consistent():
+    """N threads hammer one bounded cache with overlapping keys: every
+    lookup is a hit or a miss (no lost updates), the LRU never exceeds
+    its bound, and every returned entry is a CompiledKernel."""
+    cache = kc.KernelCache(max_entries=16)
+    nthreads, iters, nkeys = 8, 400, 48
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(iters):
+                key = ("k", (tid * 7 + i) % nkeys)
+                entry, _hit = cache.get(
+                    key, lambda: kc.CompiledKernel(lambda x=i: x))
+                assert isinstance(entry, kc.CompiledKernel)
+        except BaseException as e:       # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    s = cache.stats()
+    assert s["hits"] + s["misses"] == nthreads * iters, s
+    assert s["entries"] <= 16, s
+    assert s["misses"] >= nkeys, s     # every key missed at least once
+    # LRU invariant: the resident keys are exactly the tracked entries.
+    assert len(cache.keys()) == s["entries"]
+
+
+def test_compiled_kernel_first_call_times_once():
+    """Racing first calls record compile time exactly once and every
+    caller gets the result."""
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    entry = kc.CompiledKernel(fn)
+    outs = []
+    barrier = threading.Barrier(6)
+
+    def run(i):
+        barrier.wait()
+        outs.append(entry(i))
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(outs) == [0, 2, 4, 6, 8, 10]
+    assert entry.compiled and entry.compile_ns >= 0
+
+
+# ---------------------------------------------------------------------------
+# Metrics counters under contention
+# ---------------------------------------------------------------------------
+
+def test_metrics_add_is_atomic():
+    m = Metrics(owner="t")
+    nthreads, iters = 8, 5000
+
+    def bump():
+        for _ in range(iters):
+            m.add("n", 1)
+
+    threads = [threading.Thread(target=bump) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.values["n"] == nthreads * iters, m.values
+
+
+def test_metrics_for_registers_one_entry_across_threads():
+    from spark_rapids_tpu.ops.base import ExecContext, InMemorySourceExec
+    from spark_rapids_tpu.columnar.host import HostBatch
+    ctx = ExecContext()
+    op = InMemorySourceExec(
+        (("a", dt.INT64),),
+        [[HostBatch.from_pydict((("a", dt.INT64),), {"a": [1]})]])
+    got = []
+    barrier = threading.Barrier(8)
+
+    def reg():
+        barrier.wait()
+        got.append(ctx.metrics_for(op))
+
+    threads = [threading.Thread(target=reg) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(g is got[0] for g in got), "metrics_for raced two entries"
+
+
+# ---------------------------------------------------------------------------
+# Two queries pipelining simultaneously
+# ---------------------------------------------------------------------------
+
+def _query(session, lo, n, parts):
+    from spark_rapids_tpu.plan.logical import col
+    data = {"k": [i % 7 for i in range(lo, lo + n)],
+            "v": list(range(lo, lo + n))}
+    df = session.create_dataframe(
+        data, (("k", dt.INT64), ("v", dt.INT64)), num_partitions=parts)
+    return df.filter(col("v") % 3 != 0)
+
+
+def test_concurrent_collects_keep_counter_totals():
+    """Two sessions collect concurrently (each with the pipeline on):
+    results stay correct and each query's Recovery/Pipeline/operator
+    counters tally independently (no cross-talk, no lost updates)."""
+    from spark_rapids_tpu.plan.logical import col
+
+    def expected(lo, n):
+        return [(i % 7, i) for i in range(lo, lo + n) if i % 3 != 0]
+
+    errors = []
+    iters = 4
+
+    def run(lo):
+        try:
+            s = TpuSession()
+            s.set("spark.rapids.sql.pipeline.enabled", True)
+            for _ in range(iters):
+                df = _query(s, lo, 4000, 4)
+                got = sorted(df.collect())
+                assert got == sorted(expected(lo, 4000)), \
+                    f"query@{lo} wrong rows"
+        except BaseException as e:      # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(lo,))
+               for lo in (0, 100000)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_kernel_cache_totals_across_concurrent_queries():
+    """The process-global cache's hit+miss delta equals the sum of the
+    per-query deltas — concurrent collects may not lose counts."""
+    s0 = kc.cache().stats()
+    test_concurrent_collects_keep_counter_totals()
+    s1 = kc.cache().stats()
+    total = (s1["hits"] + s1["misses"]) - (s0["hits"] + s0["misses"])
+    assert total >= 0
+    assert s1["entries"] <= kc.cache().max_entries
