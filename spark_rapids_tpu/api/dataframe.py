@@ -342,8 +342,28 @@ class DataFrame:
         self._phys_cache = (key, phys)
         return phys
 
-    def collect(self) -> List[tuple]:
-        return self._physical().collect()
+    def collect(self, timeout_ms: Optional[float] = None) -> List[tuple]:
+        """Run the query through the multi-query scheduler
+        (parallel/scheduler.py). ``timeout_ms`` arms a deadline: a query
+        still running when it expires unwinds cooperatively at its next
+        dispatch checkpoint with ``QueryCancelledError`` (reason
+        "deadline exceeded"), releasing the TPU semaphore and every
+        owned buffer. Raises ``QueryRejectedError`` when the scheduler's
+        run queue is full (load shed) or admission times out."""
+        return self._physical().collect(timeout_ms=timeout_ms)
+
+    def submit(self, timeout_ms: Optional[float] = None):
+        """Async collect: returns a ``QueryHandle`` whose ``cancel()``
+        stops the query cooperatively — while it is still queued for
+        admission or mid-flight — and whose ``result()`` returns the
+        rows or re-raises the query's error."""
+        from spark_rapids_tpu.parallel.scheduler import QueryHandle
+        phys = self._physical()
+
+        def run(cancel_event, tmo):
+            return phys.collect(timeout_ms=tmo, cancel_event=cancel_event)
+
+        return QueryHandle(run, timeout_ms)
 
     def _host_physical(self):
         """Re-plan with sql.enabled off (the host fallback engine — no
@@ -474,13 +494,14 @@ class DataFrame:
         level = str(self._session.conf.get(C.METRICS_LEVEL)).upper()
         keep = self._METRIC_LEVELS.get(level)
         # The Recovery@query entry (stageRecomputes, watchdogKills,
-        # meshDegrades, retriesAttempted...) and the Pipeline@query entry
+        # meshDegrades, retriesAttempted...), the Pipeline@query entry
         # (hostPrefetchMs, overlapRatio, pipelineStalls,
-        # concurrentStages...) are audit trails — never filtered by
-        # verbosity level.
+        # concurrentStages...) and the Scheduler@query entry (queuedMs,
+        # admitted, cancelled, deadlineKills, crossQueryEvictions...)
+        # are audit trails — never filtered by verbosity level.
         return {k: {name: v for name, v in m.values.items()
                     if keep is None or name in keep
-                    or m.owner in ("Recovery", "Pipeline")}
+                    or m.owner in ("Recovery", "Pipeline", "Scheduler")}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

@@ -520,8 +520,13 @@ def pandas_query(name: str, data_dir: str):
     raise KeyError(name)
 
 
+# xbb_q5's ORDER BY is a computed float pivot — compare the row SET
+# under the type-aware sort (compare.sort_key), like tpch._SET_COMPARE.
+_SET_COMPARE = {"xbb_q5"}
+
+
 def check_result(name: str, got, want) -> bool:
-    from spark_rapids_tpu.benchmarks.tpch import rows_close
-    if name == "xbb_q5":
-        return rows_close(sorted(got), sorted(want))
-    return rows_close(got, want)
+    """Oracle compare through the generalized helper
+    (benchmarks/compare.py; BenchUtils.compareResults analog)."""
+    from spark_rapids_tpu.benchmarks.compare import compare_results
+    return compare_results(got, want, sort=name in _SET_COMPARE)

@@ -1291,26 +1291,11 @@ def pandas_query(name: str, data_dir: str):
 
 
 def rows_close(a, b, rel: float = 1e-6) -> bool:
-    """Shared row-list comparator (BenchUtils.compareResults analog):
-    float epsilon compare, pandas dates normalized to days-since-epoch."""
-    import math
-    if len(a) != len(b):
-        return False
-    for ra, rb in zip(a, b):
-        if len(ra) != len(rb):
-            return False
-        for va, vb in zip(ra, rb):
-            if isinstance(va, datetime.date):
-                va = (va - _EPOCH).days
-            if isinstance(vb, datetime.date):
-                vb = (vb - _EPOCH).days
-            if isinstance(va, float) or isinstance(vb, float):
-                if not math.isclose(float(va), float(vb), rel_tol=rel,
-                                    abs_tol=1e-9):
-                    return False
-            elif va != vb:
-                return False
-    return True
+    """Shared row-list comparator — the generalized helper in
+    benchmarks/compare.py (BenchUtils.compareResults analog), kept under
+    its historical name for the test suites that import it here."""
+    from spark_rapids_tpu.benchmarks.compare import compare_results
+    return compare_results(a, b, rel_tol=rel)
 
 
 # Queries ordered by a COMPUTED float (summed revenue/value): the two
@@ -1320,18 +1305,11 @@ def rows_close(a, b, rel: float = 1e-6) -> bool:
 _SET_COMPARE = {"q5", "q10", "q11"}
 
 
-def _sortkey(row):
-    return tuple((v is None, str(type(v)), v if v is not None else 0)
-                 for v in row)
-
-
 def check_result(name: str, got, want) -> bool:
     """Compare a device result against the pandas result for query
     ``name`` (BenchUtils.compareResults analog)."""
-    if name in _SET_COMPARE:
-        return rows_close(sorted(got, key=_sortkey),
-                          sorted(want, key=_sortkey))
-    return rows_close(got, want)
+    from spark_rapids_tpu.benchmarks.compare import compare_results
+    return compare_results(got, want, sort=name in _SET_COMPARE)
 
 
 def bytes_scanned(name: str, data_dir: str) -> int:

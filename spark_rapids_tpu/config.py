@@ -492,6 +492,40 @@ MESH_DEGRADE_ENABLED = conf("spark.rapids.sql.mesh.degrade.enabled").doc(
     "ShuffleExchangeExec path (counter meshDegrades) instead of killing "
     "the query. Off = collective failures propagate.").boolean(True)
 
+SCHEDULER_MAX_CONCURRENT = conf(
+    "spark.rapids.sql.scheduler.maxConcurrentQueries").doc(
+    "Multi-query admission control (parallel/scheduler.py): at most this "
+    "many collect()s execute at once; excess queries wait in the bounded "
+    "run queue. 1 = strictly serial queries (byte-identical to the "
+    "pre-scheduler engine); the SRT_SCHEDULER_MAX_CONCURRENT env "
+    "overrides for a whole process.").integer(2)
+
+SCHEDULER_QUEUE_DEPTH = conf("spark.rapids.sql.scheduler.queueDepth").doc(
+    "Admission run-queue bound: queries beyond maxConcurrentQueries "
+    "wait here, FIFO. A query arriving with the queue full is SHED with "
+    "QueryRejectedError instead of letting unbounded concurrency OOM "
+    "the device.").integer(16)
+
+SCHEDULER_ADMISSION_TIMEOUT_MS = conf(
+    "spark.rapids.sql.scheduler.admissionTimeoutMs").doc(
+    "How long a queued query waits for a run slot before it is shed "
+    "with QueryRejectedError (queuedMs reports the wait of admitted "
+    "queries).").integer(60000)
+
+SCHEDULER_QUERY_MEMORY_FRACTION = conf(
+    "spark.rapids.sql.scheduler.queryMemoryFraction").doc(
+    "Fair-share fraction of the device budget each admitted query's "
+    "buffer catalog is charged against. 0 = auto "
+    "(1/maxConcurrentQueries when queries can overlap, else the full "
+    "budget); 1.0 = every query sees the full budget and isolation "
+    "relies on admission + cross-query eviction.").double(1.0)
+
+TEST_FAULTS_QUERY_TAG = conf(
+    "spark.rapids.sql.test.faults.queryTag").doc(
+    "Explicit fault tag for query-scoped chaos (kind@site/query=N "
+    "entries fire only on the query whose tag is N). -1 = untagged: "
+    "the scheduler admission ordinal is the tag.").integer(-1)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
@@ -649,8 +683,37 @@ def generate_docs() -> str:
         "tests/test_stage_recovery.py. Recovery counters",
         "(retriesAttempted, spillEscalations, hostFallbacks,",
         "faultsInjected, corruptionsDetected, stageRecomputes,",
-        "partitionRetries, watchdogKills, meshDegrades) surface",
+        "partitionRetries, watchdogKills, meshDegrades,",
+        "meshCollectiveSkipped, crossQueryEvictions) surface",
         "through `DataFrame.metrics()` and bench.py's JSON report.",
+        "",
+        "## Multi-query admission, isolation & cancellation",
+        "",
+        "Concurrent `collect()`s from multiple threads run through the",
+        "process-wide QueryManager (parallel/scheduler.py): at most",
+        "`spark.rapids.sql.scheduler.maxConcurrentQueries` queries",
+        "execute at once, excess queries wait FIFO in a run queue of",
+        "`scheduler.queueDepth`, and a query arriving with the queue",
+        "full — or waiting past `scheduler.admissionTimeoutMs` — is",
+        "SHED with `QueryRejectedError` instead of oversubscribing the",
+        "device. Each admitted query gets an owner id that tags every",
+        "catalog buffer and kernel-cache reservation it creates, a",
+        "fair-share device budget (`scheduler.queryMemoryFraction`),",
+        "and a cooperative cancellation token:",
+        "`DataFrame.collect(timeout_ms=...)` arms a deadline and",
+        "`DataFrame.submit().cancel()` stops a query mid-flight — both",
+        "unwind with `QueryCancelledError` at the next dispatch",
+        "checkpoint, releasing the TPU semaphore and every owned buffer",
+        "(the catalog leak report proves teardown freed everything).",
+        "The OOM ladder spills the offending query's own buffers",
+        "through two rungs before evicting neighbors",
+        "(`crossQueryEvictions`), and query-scoped fault arming",
+        "(`kind@site/query=N` with",
+        "`spark.rapids.sql.test.faults.queryTag`) lets chaos tests",
+        "prove a fault injected into one query is invisible to its",
+        "neighbors. `SRT_SCHEDULER_MAX_CONCURRENT=1` degenerates to",
+        "strictly serial queries, byte-identical to the pre-scheduler",
+        "engine. See docs/robustness.md and tests/test_scheduler.py.",
         "",
         "## Dynamic per-rule kill switches",
         "",

@@ -14,7 +14,7 @@ results.
 Spec grammar (``spark.rapids.sql.test.faults`` config or ``SRT_FAULTS``
 env)::
 
-    kind@site[:arg][,kind@site[:arg]...]
+    kind@site[/query=N][:arg][,kind@site[/query=N][:arg]...]
 
 - ``kind``: ``oom`` (raises a synthetic RESOURCE_EXHAUSTED, recovered by
   the OOM escalation ladder), ``transient`` (raises a synthetic
@@ -38,10 +38,27 @@ env)::
   1); a float p in (0, 1) fires per-hit with probability p from a
   deterministic per-site PRNG seeded by
   ``spark.rapids.sql.test.faults.seed`` / ``SRT_FAULTS_SEED``.
+- ``/query=N``: query-scoped arming — the entry fires only on hits made
+  by the query whose fault tag is ``N`` (the explicit
+  ``spark.rapids.sql.test.faults.queryTag`` conf, falling back to the
+  scheduler admission ordinal). Cross-query chaos tests inject a fault
+  into query A and assert query B's results and counters are
+  bit-identical to a solo run (parallel/scheduler.py, ISSUE 5).
+
+This module also carries the per-thread QUERY TOKEN — the cooperative
+cancellation/deadline handle the QueryManager (parallel/scheduler.py)
+issues at admission. Every dispatch funnel already calls
+:func:`fault_point`, so the same funnels double as cancellation
+checkpoints: a cancelled or deadline-expired query unwinds with
+:class:`QueryCancelledError` at its next dispatch, releasing the TPU
+semaphore and every owned buffer on the way out. The token lives here
+(not in the scheduler) because deep dispatch code may import faults but
+must not import the scheduler.
 
 The registry is process-global and ARMED only while a non-empty spec is
-configured; a disarmed ``fault_point`` is a single attribute load, so
-production dispatch pays nothing. Every injection/recovery event bumps
+configured; a disarmed ``fault_point`` is two attribute loads (the
+cancellation checkpoint + the injector), so production dispatch pays
+almost nothing. Every injection/recovery event bumps
 the process-global counters (``faultsInjected``, ``retriesAttempted``,
 ``spillEscalations``, ``hostFallbacks``, ``corruptionsDetected``) and,
 when a query is running, the per-query ``Recovery`` Metrics sink —
@@ -114,22 +131,100 @@ class InjectedStallError(RuntimeError):
         self.site = site
 
 
-class FaultSpec:
-    """One parsed ``kind@site:arg`` entry."""
+class QueryCancelledError(RuntimeError):
+    """The query was cancelled (explicit ``cancel()``) or its deadline
+    expired (``collect(timeout_ms=...)``). The message deliberately
+    carries NO transient/OOM marker: a cancelled query must unwind
+    through every retry ladder — not be lovingly retried by one."""
 
-    __slots__ = ("kind", "site", "count", "probability", "fired")
+    def __init__(self, query_id: int, reason: str):
+        super().__init__(
+            f"CANCELLED: query {query_id} {reason} "
+            "(spark.rapids.sql.scheduler.*)")
+        self.query_id = query_id
+        self.reason = reason
+
+
+class QueryToken:
+    """Per-query cooperative cancellation/deadline handle, issued by the
+    QueryManager at admission and registered thread-locally on every
+    thread that works for the query (the collect thread itself, watchdog
+    attempt workers, pipeline prefetchers, concurrent stage threads).
+
+    ``cancel`` is a plain Event so blocking waits (semaphore admission,
+    pipeline ``_take``, injected stalls) can wake on it; ``reason`` is
+    set before the event so the unwinding error names why. The deadline
+    is enforced by the scheduler's timer arm (it sets the same event),
+    so checkpoints only ever test one flag."""
+
+    __slots__ = ("query_id", "fault_tag", "cancel", "reason")
+
+    def __init__(self, query_id: int, fault_tag: Optional[int] = None):
+        self.query_id = query_id
+        # The tag query-scoped fault entries (kind@site/query=N) match.
+        self.fault_tag = fault_tag if fault_tag is not None else query_id
+        self.cancel = threading.Event()
+        self.reason = "cancelled"
+
+    def request_cancel(self, reason: str = "cancelled") -> None:
+        self.reason = reason
+        self.cancel.set()
+
+    def cancelled(self) -> bool:
+        return self.cancel.is_set()
+
+    def error(self) -> QueryCancelledError:
+        return QueryCancelledError(self.query_id, self.reason)
+
+
+def set_query_token(token: Optional[QueryToken]) -> None:
+    """Register the active query's token for the calling thread. Helper
+    threads (watchdog attempts, prefetch pool, stage pool) propagate it
+    exactly like the recovery sink — thread-locals don't inherit."""
+    _TL.query = token
+
+
+def get_query_token() -> Optional[QueryToken]:
+    return getattr(_TL, "query", None)
+
+
+def check_cancelled() -> None:
+    """Cancellation checkpoint: raise :class:`QueryCancelledError` when
+    the calling thread's query was cancelled or deadlined. A single
+    thread-local load + event test when a token is registered; a single
+    attribute load when not — cheap enough for every dispatch funnel
+    (:func:`fault_point` calls it first)."""
+    tok = getattr(_TL, "query", None)
+    if tok is not None and tok.cancel.is_set():
+        raise tok.error()
+
+
+def current_query_id() -> Optional[int]:
+    """The calling thread's query id (owner tag for catalog buffers and
+    kernel-cache reservations), or None outside a managed query."""
+    tok = getattr(_TL, "query", None)
+    return None if tok is None else tok.query_id
+
+
+class FaultSpec:
+    """One parsed ``kind@site[/query=N]:arg`` entry."""
+
+    __slots__ = ("kind", "site", "count", "probability", "fired", "query")
 
     def __init__(self, kind: str, site: str, count: Optional[int],
-                 probability: Optional[float]):
+                 probability: Optional[float],
+                 query: Optional[int] = None):
         self.kind = kind
         self.site = site
         self.count = count              # fire on the first N hits
         self.probability = probability  # or per-hit Bernoulli(p)
+        self.query = query              # only for this query tag (None=any)
         self.fired = 0
 
     def __repr__(self):  # pragma: no cover - debug
         arg = self.probability if self.count is None else self.count
-        return f"FaultSpec({self.kind}@{self.site}:{arg})"
+        q = "" if self.query is None else f"/query={self.query}"
+        return f"FaultSpec({self.kind}@{self.site}{q}:{arg})"
 
 
 _KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall")
@@ -158,6 +253,19 @@ def parse_spec(spec: str) -> List[FaultSpec]:
         else:
             site, arg = rest, "1"
         site = site.strip()
+        query: Optional[int] = None
+        if "/" in site:
+            site, qpart = site.split("/", 1)
+            site = site.strip()
+            qpart = qpart.strip()
+            if not qpart.startswith("query="):
+                raise FaultParseError(
+                    f"bad fault entry {entry!r}: expected /query=N")
+            try:
+                query = int(qpart[len("query="):])
+            except ValueError:
+                raise FaultParseError(
+                    f"bad fault entry {entry!r}: query tag must be an int")
         if not site:
             raise FaultParseError(f"bad fault entry {entry!r}: empty site")
         arg = arg.strip()
@@ -167,13 +275,13 @@ def parse_spec(spec: str) -> List[FaultSpec]:
                 if not 0.0 < p <= 1.0:
                     raise FaultParseError(
                         f"fault probability out of (0, 1]: {entry!r}")
-                out.append(FaultSpec(kind, site, None, p))
+                out.append(FaultSpec(kind, site, None, p, query))
             else:
                 n = int(arg)
                 if n < 1:
                     raise FaultParseError(
                         f"fault count must be >= 1: {entry!r}")
-                out.append(FaultSpec(kind, site, n, None))
+                out.append(FaultSpec(kind, site, n, None, query))
         except ValueError as e:
             if isinstance(e, FaultParseError):
                 raise
@@ -201,16 +309,21 @@ class FaultInjector:
             rng = self._rngs[site] = random.Random(f"{self.seed}:{site}")
         return rng
 
-    def should_fire(self, site: str, kinds) -> Optional[FaultSpec]:
+    def should_fire(self, site: str, kinds,
+                    query: Optional[int] = None) -> Optional[FaultSpec]:
         """One hit of ``site``; returns the spec entry that fires (first
         match wins) or None. Thread-safe and deterministic for count
         faults; probability faults are deterministic given a
-        deterministic hit order."""
+        deterministic hit order. ``query`` is the hitting query's fault
+        tag — query-scoped entries fire only on matching hits, so chaos
+        in query A is invisible to query B."""
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
             for e in self.entries:
                 if e.site != site or e.kind not in kinds:
+                    continue
+                if e.query is not None and e.query != query:
                     continue
                 if e.count is not None:
                     if e.fired < e.count:
@@ -350,30 +463,53 @@ def reset_counters() -> None:
 STALL_TIMEOUT_S = float(os.environ.get("SRT_STALL_TIMEOUT_S", "30"))
 
 
+def _current_fault_tag() -> Optional[int]:
+    """The calling thread's query fault tag (for kind@site/query=N
+    matching), or None outside a managed query — query-scoped entries
+    then never fire."""
+    tok = getattr(_TL, "query", None)
+    return None if tok is None else tok.fault_tag
+
+
 def _stall(site: str) -> None:
     """Injected stall: hang this dispatch like a wedged device call.
     With a watchdog armed (worker thread registered a cancel event) the
-    wait ends the instant the watchdog kills the attempt; without one,
-    the bounded safety timeout expires. Either way the dispatch unwinds
-    with :class:`InjectedStallError` — a stall never 'completes'."""
+    wait ends the instant the watchdog kills the attempt; a registered
+    query token likewise ends it on cancel/deadline; without either, the
+    bounded safety timeout expires. Either way the dispatch unwinds —
+    with :class:`QueryCancelledError` on a query cancel, else
+    :class:`InjectedStallError` — a stall never 'completes'."""
     cancel = getattr(_TL, "cancel", None)
-    if cancel is not None:
-        cancel.wait(STALL_TIMEOUT_S)
-    else:
-        time.sleep(STALL_TIMEOUT_S)
+    tok = getattr(_TL, "query", None)
+    deadline = time.monotonic() + STALL_TIMEOUT_S
+    while time.monotonic() < deadline:
+        if cancel is not None and cancel.is_set():
+            break
+        if tok is not None:
+            if tok.cancel.wait(0.02):
+                raise tok.error()
+        elif cancel is not None:
+            cancel.wait(0.05)
+        else:
+            time.sleep(0.05)
     raise InjectedStallError(site)
 
 
 def fault_point(site: str, owner: Optional[int] = None) -> None:
-    """Named injection site. No-op unless a schedule is armed; raises
-    the synthetic error when an ``oom``/``transient``/``lostoutput``
-    entry fires, or hangs (then unwinds) on a ``stall``. ``owner`` tags
-    a lostoutput with the owning exchange exec's id so lineage recovery
-    can invalidate exactly that stage's output."""
+    """Named injection site AND cancellation checkpoint. Checks the
+    calling thread's query token first (a cancelled/deadlined query
+    unwinds here with :class:`QueryCancelledError`); beyond that it is a
+    no-op unless a schedule is armed — raising the synthetic error when
+    an ``oom``/``transient``/``lostoutput`` entry fires, or hanging
+    (then unwinding) on a ``stall``. ``owner`` tags a lostoutput with
+    the owning exchange exec's id so lineage recovery can invalidate
+    exactly that stage's output."""
+    check_cancelled()
     inj = _INJECTOR
     if inj is None:
         return
-    e = inj.should_fire(site, ("oom", "transient", "lostoutput", "stall"))
+    e = inj.should_fire(site, ("oom", "transient", "lostoutput", "stall"),
+                        _current_fault_tag())
     if e is None:
         return
     record("faultsInjected")
@@ -398,7 +534,7 @@ def corrupt_blob(site: str, blob: bytes) -> bytes:
     inj = _INJECTOR
     if inj is None or not blob:
         return blob
-    e = inj.should_fire(site, ("corrupt",))
+    e = inj.should_fire(site, ("corrupt",), _current_fault_tag())
     if e is None:
         return blob
     record("faultsInjected")

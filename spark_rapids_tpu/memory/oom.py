@@ -16,7 +16,12 @@ after every rung:
    transient headroom misses).
 2. ``spill-all``: spill EVERY spillable device buffer (the reference's
    alloc-failure callback behavior).
-3. ``shrink``: halve the process-wide degraded batch target
+3. ``evict-neighbors``: under the multi-query scheduler
+   (parallel/scheduler.py) with concurrent queries in flight, spill the
+   OTHER active queries' device buffers to host — the offending query's
+   own buffers always go first (rungs 1-2), so isolation holds until
+   the device is truly full (``crossQueryEvictions``).
+4. ``shrink``: halve the process-wide degraded batch target
    (:func:`effective_batch_target`) so every SUBSEQUENT coalesce/serve
    dispatch issues smaller batches, then retry once more.
 
@@ -93,6 +98,7 @@ _degrade_factor = 1
 
 RUNG_SPILL_SOME = "spill-some"
 RUNG_SPILL_ALL = "spill-all"
+RUNG_EVICT_NEIGHBORS = "evict-neighbors"
 RUNG_SHRINK = "shrink"
 
 # Rung names of the LAST completed ladder, in firing order (introspection
@@ -133,9 +139,26 @@ def reset_degradation() -> None:
 
 # -- the ladder ---------------------------------------------------------------
 
+def _evict_neighbor_queries() -> int:
+    """Cross-query eviction rung: after the offending query has spilled
+    everything IT owns (the first two rungs walk its own catalog), ask
+    the QueryManager to spill the other active queries' buffers to host
+    — isolation means the offender pays first, not that neighbors are
+    untouchable while the device is truly full. No-op (0 bytes) outside
+    a managed query or with no concurrent neighbors."""
+    tok = faults.get_query_token()
+    if tok is None:
+        return 0
+    from spark_rapids_tpu.parallel import scheduler
+    mgr = scheduler.get_query_manager()
+    return mgr.evict_neighbors(tok.query_id)
+
+
 def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:
     """Run ``fn``; on a device OOM walk the spill-some -> spill-all ->
-    shrink escalation ladder, retrying the dispatch after each rung.
+    evict-neighbors -> shrink escalation ladder, retrying the dispatch
+    after each rung (neighbor eviction only under the QueryManager with
+    concurrent queries — the offender's own buffers always go first).
     Anything else propagates; a ladder that never frees or changes
     anything re-raises immediately (the retry would just fail again)."""
     try:
@@ -152,11 +175,14 @@ def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:
         faults.record("retriesAttempted")
         return fn(*args, **kwargs)
 
-    for rung in (RUNG_SPILL_SOME, RUNG_SPILL_ALL, RUNG_SHRINK):
+    for rung in (RUNG_SPILL_SOME, RUNG_SPILL_ALL, RUNG_EVICT_NEIGHBORS,
+                 RUNG_SHRINK):
         if rung == RUNG_SPILL_SOME:
             acted = catalog is not None and catalog.spill_some() > 0
         elif rung == RUNG_SPILL_ALL:
             acted = catalog is not None and catalog.handle_oom() > 0
+        elif rung == RUNG_EVICT_NEIGHBORS:
+            acted = _evict_neighbor_queries() > 0
         else:
             acted = shrink_batch_target()
         if not acted:

@@ -118,6 +118,11 @@ class BufferEntry:
     tier: str
     size_bytes: int
     priority: int
+    # Owning query id (RapidsBufferCatalog's owner tagging): per-query
+    # accounting, the leak report's attribution, and the proof that a
+    # cancelled query's teardown freed everything it owned. None =
+    # unmanaged (unit tests, direct Exec.collect).
+    owner: Optional[int] = None
     # Exactly one of these is set, per tier:
     device_batch: Optional[DeviceBatch] = None
     host_meta: Optional[dict] = None
@@ -135,11 +140,16 @@ class BufferCatalog:
                  host_budget_bytes: int = 1 << 30,
                  spill_dir: str = "/tmp/spark_rapids_tpu_spill",
                  compression_codec: str = "none",
-                 debug: bool = False):
+                 debug: bool = False,
+                 owner: Optional[int] = None):
         from spark_rapids_tpu.memory.compression import get_codec
         from spark_rapids_tpu.memory.native import open_spill_file
         self.device_budget = device_budget_bytes
         self.host_budget = host_budget_bytes
+        # Default owner tag for every buffer this catalog registers —
+        # the admitting QueryManager's query id (catalogs are per-query,
+        # so catalog owner == buffer owner unless a caller overrides).
+        self.owner = owner
         self._entries: Dict[int, BufferEntry] = {}
         self._next_id = itertools.count()
         self._device_bytes = 0
@@ -161,13 +171,15 @@ class BufferCatalog:
 
     # -- registration --------------------------------------------------------
     def add_batch(self, batch: DeviceBatch,
-                  priority: int = PRIORITY_DEFAULT) -> int:
+                  priority: int = PRIORITY_DEFAULT,
+                  owner: Optional[int] = None) -> int:
         size = batch.device_size_bytes()
         with self._lock:
             self._ensure_device_room(size)
             bid = next(self._next_id)
             self._entries[bid] = BufferEntry(
                 bid, StorageTier.DEVICE, size, priority,
+                owner=owner if owner is not None else self.owner,
                 device_batch=batch)
             self._device_bytes += size
             if self.debug:
@@ -386,6 +398,15 @@ class BufferCatalog:
     def disk_bytes(self) -> int:
         return self._spill_file.allocated_bytes
 
+    def owned_bytes(self) -> Dict[Optional[int], int]:
+        """Registered bytes per owner tag (any tier) — the per-query
+        accounting view the scheduler's isolation tests assert on."""
+        out: Dict[Optional[int], int] = {}
+        with self._lock:
+            for e in self._entries.values():
+                out[e.owner] = out.get(e.owner, 0) + e.size_bytes
+        return out
+
     def leak_report(self) -> List[Tuple[int, int, str]]:
         """Buffers still registered: (id, bytes, creation stack) — the
         MemoryCleaner leak-callstack analog. Stacks are recorded only in
@@ -470,7 +491,17 @@ class TpuSemaphore:
         self.permits = permits
 
     def __enter__(self):
-        self._sem.acquire()
+        # Cancellation-aware: a query cancelled/deadlined while QUEUED
+        # for the device must unwind instead of eventually grabbing a
+        # permit it will never use (its neighbors keep the device busy).
+        from spark_rapids_tpu import faults
+        tok = faults.get_query_token()
+        if tok is None:
+            self._sem.acquire()
+            return self
+        while not self._sem.acquire(timeout=0.05):
+            if tok.cancelled():
+                raise tok.error()
         return self
 
     def __exit__(self, *exc):

@@ -62,6 +62,14 @@ class ExecContext:
     conf: TpuConf = dataclasses.field(default_factory=TpuConf)
     metrics: Dict[str, Metrics] = dataclasses.field(default_factory=dict)
     cache: Dict[str, object] = dataclasses.field(default_factory=dict)
+    # The admitting QueryManager ticket (parallel/scheduler.py): carries
+    # the query id (catalog owner tag), the fair-share memory fraction,
+    # and the cancellation token. None = unmanaged context (unit tests,
+    # host oracle runs) — full budget, no owner, today's behavior.
+    query: Optional[object] = None
+    # Catalog leak report captured at close() AFTER owned handles were
+    # released: [] proves query teardown freed everything it owned.
+    last_leak_report: Optional[list] = None
     _catalog: Optional[object] = None
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False)
@@ -106,6 +114,16 @@ class ExecContext:
                         self.conf.get(C.MAX_ALLOC_FRACTION))) \
                         - int(self.conf.get(C.RESERVE_BYTES))
                     budget = max(min(budget, ceiling), 1 << 20)
+                owner = None
+                if self.query is not None:
+                    # Managed query: fair-share budget + owner tagging
+                    # (scheduler.queryMemoryFraction; GpuSemaphore +
+                    # owner-tagged RapidsBufferCatalog analog).
+                    from spark_rapids_tpu.parallel import scheduler as SC
+                    frac = SC.query_memory_fraction(
+                        self.conf, SC.get_query_manager(self.conf))
+                    budget = max(int(budget * frac), 1 << 20)
+                    owner = self.query.query_id
                 self._catalog = BufferCatalog(
                     device_budget_bytes=budget,
                     host_budget_bytes=int(
@@ -113,11 +131,37 @@ class ExecContext:
                     spill_dir=str(self.conf.get(C.SPILL_DIR)),
                     compression_codec=str(
                         self.conf.get(C.SHUFFLE_COMPRESSION_CODEC)),
-                    debug=bool(self.conf.get(C.MEMORY_DEBUG)))
+                    debug=bool(self.conf.get(C.MEMORY_DEBUG)),
+                    owner=owner)
         return self._catalog
+
+    def release_owned(self):
+        """Close every durable handle this context still holds (shuffle
+        buckets, broadcast singles, mesh shards — SpillableBatch handles
+        parked in ``cache``): query teardown must free everything the
+        query owned whether it succeeded, failed, or was cancelled."""
+        from spark_rapids_tpu.memory.stores import SpillableBatch
+
+        def close_in(obj, depth: int = 0):
+            if isinstance(obj, SpillableBatch):
+                obj.close()
+            elif depth < 3 and isinstance(obj, (list, tuple)):
+                for x in obj:
+                    close_in(x, depth + 1)
+            elif depth < 3 and isinstance(obj, dict):
+                for x in obj.values():
+                    close_in(x, depth + 1)
+
+        for v in list(self.cache.values()):
+            close_in(v)
 
     def close(self):
         if self._catalog is not None:
+            self.release_owned()
+            # The leak report AFTER releasing owned handles: non-empty
+            # means a buffer escaped its owner's teardown — the
+            # scheduler's isolation tests assert this is [].
+            self.last_leak_report = self._catalog.leak_report()
             self._catalog.close()
             self._catalog = None
 
@@ -283,16 +327,19 @@ class Exec:
         timeout_s = wd.timeout_ms / 1000.0
         catalog = get_active_catalog()
         sink = faults.get_recovery_sink()
+        token = faults.get_query_token()
         for attempt in range(wd.max_attempts):
             cancel = threading.Event()
             box: Dict[str, object] = {}
 
             def work():
                 # Thread-locals don't inherit: the worker needs the
-                # query's spill catalog (OOM ladder), recovery sink, and
-                # its attempt's cancel event.
+                # query's spill catalog (OOM ladder), recovery sink,
+                # query token (cancellation/owner/fault tag), and its
+                # attempt's cancel event.
                 set_active_catalog(catalog)
                 faults.set_recovery_sink(sink)
+                faults.set_query_token(token)
                 faults.set_cancel_event(cancel)
                 try:
                     box["out"] = fn()
@@ -385,6 +432,10 @@ class Exec:
                         pipe = PL.open_pipeline(ctx, self, nparts)
                         try:
                             for p in range(nparts):
+                                # Per-partition cancellation checkpoint
+                                # (the deep funnels check too, via
+                                # fault_point).
+                                faults.check_cancelled()
                                 # consume() waits for p's host half then
                                 # returns the device stream verbatim, so
                                 # the serial path keeps streaming exactly

@@ -168,6 +168,11 @@ class KernelCache:
     def __init__(self, max_entries: int = DEFAULT_MAX_ENTRIES):
         self._entries: "collections.OrderedDict[Any, Any]" = \
             collections.OrderedDict()
+        # key -> query id that paid the compile (owner tag; None when
+        # compiled outside a managed query). The cache itself stays
+        # process-global — sharing compiled kernels across queries is
+        # the point — but reservations are attributable.
+        self._owners: Dict[Any, Any] = {}
         self._lock = threading.RLock()
         self.max_entries = max_entries
         self.hits = 0
@@ -197,13 +202,21 @@ class KernelCache:
             self.misses += 1
             entry = builder()
             self._entries[key] = entry
+            from spark_rapids_tpu import faults
+            self._owners[key] = faults.current_query_id()
             self._evict()
             return entry, False
 
     def _evict(self):
         while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
+            key, _ = self._entries.popitem(last=False)
+            self._owners.pop(key, None)
             self.evictions += 1
+
+    def owners(self) -> Dict[Any, Any]:
+        """key -> owning query id (None = unmanaged compile)."""
+        with self._lock:
+            return dict(self._owners)
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -224,6 +237,7 @@ class KernelCache:
     def clear(self):
         with self._lock:
             self._entries.clear()
+            self._owners.clear()
             self.reset_stats()
 
     def keys(self) -> List[Any]:

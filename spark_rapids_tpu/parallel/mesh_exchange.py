@@ -230,11 +230,30 @@ class MeshExchangeExec(Exec):
         key = f"meshx:{id(self):x}"
         if key in ctx.cache:
             return ctx.cache[key]
+        if ctx.cache.get(f"meshx-skip:{id(self):x}"):
+            return None         # shape mismatch already diagnosed once
         m = ctx.metrics_for(self)
         mesh = mesh_for(ctx)
         n = mesh.devices.size
-        assert n == self.partitioning.num_partitions, \
-            "mesh exchange partition count must equal mesh size"
+        if n != self.partitioning.num_partitions:
+            # Shape mismatch (a conf-forced partition count, a mesh that
+            # shrank between planning and execution): the collective
+            # cannot run as one uniform shard per device. Degrade
+            # OBSERVABLY — warning + meshCollectiveSkipped counter +
+            # single-process fallback, matching the PR 3 degrade
+            # philosophy — instead of silently skipping (or asserting
+            # the query to death).
+            import logging
+            from spark_rapids_tpu import faults
+            logging.getLogger("spark_rapids_tpu").warning(
+                "mesh collective skipped in %s: partition count %d != "
+                "mesh size %d; serving this exchange from the "
+                "single-process shuffle path", self.name,
+                self.partitioning.num_partitions, n)
+            faults.record("meshCollectiveSkipped")
+            m.add("meshCollectiveSkipped", 1)
+            ctx.cache[f"meshx-skip:{id(self):x}"] = True
+            return None
         # Deal child partitions onto devices round-robin.
         per_dev: List[List[DeviceBatch]] = [[] for _ in range(n)]
         child = self.children[0]
@@ -314,6 +333,7 @@ class MeshExchangeExec(Exec):
         parallel/stages.py)."""
         handles = ctx.cache.pop(f"meshx:{id(self):x}", None)
         ctx.cache.pop(f"meshx-host:{id(self):x}", None)
+        ctx.cache.pop(f"meshx-skip:{id(self):x}", None)
         if handles:
             for h in handles:
                 h.close()

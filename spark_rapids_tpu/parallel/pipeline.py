@@ -182,6 +182,7 @@ class PartitionPipeline:
         self._nparts = nparts
         self._depth = params.prefetch_partitions
         self._sink = faults.get_recovery_sink()
+        self._token = faults.get_query_token()
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=min(params.host_threads, max(nparts, 1)),
             thread_name_prefix="srt-prefetch")
@@ -193,6 +194,7 @@ class PartitionPipeline:
     def _prefetch_task(self, partition: int, cancel) -> None:
         from spark_rapids_tpu import faults
         faults.set_recovery_sink(self._sink)
+        faults.set_query_token(self._token)
         faults.set_cancel_event(cancel)
         t0 = time.perf_counter()
         try:
@@ -200,6 +202,7 @@ class PartitionPipeline:
                 self._source.prefetch_host(self._ctx, partition)
         finally:
             faults.set_cancel_event(None)
+            faults.set_query_token(None)
             faults.set_recovery_sink(None)
             _record(self._ctx, "hostPrefetchMs",
                     (time.perf_counter() - t0) * 1000.0)
@@ -238,6 +241,13 @@ class PartitionPipeline:
                 except concurrent.futures.TimeoutError:
                     if fut.done():
                         raise   # the TASK raised TimeoutError, not the poll
+                    # Query cancel/deadline: stop waiting, cancel the
+                    # prefetch, and unwind at this ordered point — the
+                    # same place a prefetch fault would have surfaced.
+                    tok = faults.get_query_token()
+                    if tok is not None and tok.cancelled():
+                        slot.cancel.set()
+                        raise tok.error()
                     wd_cancel = faults.get_cancel_event()
                     if wd_cancel is not None and wd_cancel.is_set():
                         # Watchdog killed this attempt: cancel the
@@ -246,7 +256,7 @@ class PartitionPipeline:
                         slot.cancel.set()
                         raise _ConsumeCancelled(
                             f"partition {partition} consume cancelled")
-        except _ConsumeCancelled:
+        except (_ConsumeCancelled, faults.QueryCancelledError):
             raise
         except BaseException:
             if slot.cancel.is_set():
@@ -328,6 +338,7 @@ def prematerialize_stages(ctx, root) -> None:
     wd = _watchdog_params(ctx.conf)
     catalog = get_active_catalog()
     sink = faults.get_recovery_sink()
+    token = faults.get_query_token()
 
     def run_stage(st):
         def materialize():
@@ -341,9 +352,11 @@ def prematerialize_stages(ctx, root) -> None:
     def run_stage_threaded(st):
         set_active_catalog(catalog)
         faults.set_recovery_sink(sink)
+        faults.set_query_token(token)
         try:
             run_stage(st)
         finally:
+            faults.set_query_token(None)
             faults.set_recovery_sink(None)
 
     done: set = set()

@@ -1,0 +1,366 @@
+"""Multi-query admission control, isolation, and cancellation (ISSUE 5).
+
+Every robustness layer before this one (fault/recovery ladder, lineage
+recovery, watchdog, pipelined executor) assumed exactly one query in
+flight: the TPU semaphore serializes device *partitions*, not *queries*,
+each query's buffer catalog believes it owns the whole device budget,
+and nothing could cancel, deadline, or shed a query. The reference gets
+cross-task isolation for free from Spark's scheduler plus GpuSemaphore
+and the owner-tagged RapidsBufferCatalog (SURVEY §2.2); this module is
+the single-process re-design of that layer. Four pieces:
+
+1. **Admission control** — :class:`QueryManager` holds a bounded run
+   queue (``spark.rapids.sql.scheduler.{maxConcurrentQueries,queueDepth,
+   admissionTimeoutMs}``). At most ``maxConcurrentQueries`` collects run
+   at once; excess queries wait FIFO in a queue of ``queueDepth``; a
+   query arriving with the queue full — or waiting past the admission
+   timeout — is SHED with :class:`QueryRejectedError` instead of letting
+   unbounded concurrency OOM the device (the reference leans on Spark's
+   task scheduler for the same bound).
+
+2. **Per-query resource isolation** — every admitted query gets a
+   monotonically increasing query id; its catalog (and every buffer,
+   stage output, and kernel-cache reservation it creates) is owner-tagged
+   with that id, its device budget is scaled by the fair share
+   (``scheduler.queryMemoryFraction``), and the OOM ladder spills the
+   *offending* query's buffers (its own catalog) through two rungs
+   before :func:`evict_neighbors` touches anyone else's
+   (``crossQueryEvictions``). Teardown — success, failure, or cancel —
+   closes every owned handle and records the catalog leak report as the
+   proof (``ExecContext.last_leak_report``).
+
+3. **Cooperative cancellation + deadlines** — admission issues a
+   :class:`faults.QueryToken`; ``DataFrame.collect(timeout_ms=...)``
+   arms a deadline timer on it and :meth:`QueryHandle.cancel` sets it
+   directly. Every dispatch funnel's ``fault_point`` doubles as a
+   cancellation checkpoint, the TPU semaphore acquire and the pipeline's
+   ordered wait poll the token, and the watchdog/prefetch/stage worker
+   threads inherit it — so a cancelled query unwinds mid-flight with
+   :class:`faults.QueryCancelledError`, releasing the semaphore and all
+   owned buffers on the way out.
+
+4. **Cross-query fault containment** — faults.py's query-scoped arming
+   (``kind@site/query=N``) matches the token's fault tag, so chaos
+   tests inject an OOM/stall/lostoutput into query A and assert query
+   B's results and recovery counters are bit-identical to a solo run
+   (tests/test_scheduler.py).
+
+Counters (process-global here + the per-query ``Scheduler@query``
+metrics entry): ``queuedMs``, ``admitted``, ``rejected``, ``cancelled``,
+``deadlineKills``, ``crossQueryEvictions``.
+
+``SRT_SCHEDULER_MAX_CONCURRENT=1`` (env) degenerates to strictly serial
+queries — byte-identical to the pre-scheduler engine (the CI matrix
+proves it over the whole suite).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu import faults
+
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {}
+
+
+def _record(name: str, amount: float = 1) -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + amount
+
+
+def counters() -> Dict[str, float]:
+    """Process-global scheduler counters (bench.py's ``scheduler`` JSON
+    block)."""
+    with _COUNTER_LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_counters() -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS.clear()
+
+
+def metrics_entry(ctx):
+    """The per-query Scheduler metrics entry (next to Recovery@query)."""
+    from spark_rapids_tpu.ops.base import Metrics
+    return ctx.metrics.setdefault("Scheduler@query",
+                                  Metrics(owner="Scheduler"))
+
+
+class QueryRejectedError(RuntimeError):
+    """Load shed: the run queue was full, or the admission wait timed
+    out. Deliberately NOT a transient error (no retry marker): the
+    caller — a serving tier, a test — decides whether to resubmit."""
+
+    def __init__(self, reason: str):
+        super().__init__(
+            f"REJECTED: {reason} (spark.rapids.sql.scheduler.*)")
+        self.reason = reason
+
+
+class QueryTicket:
+    """One admitted query: its token (cancellation handle + owner id),
+    admission bookkeeping, and the context registration cross-query
+    eviction walks."""
+
+    __slots__ = ("token", "queued_ms", "ctx", "deadline_timer")
+
+    def __init__(self, token: faults.QueryToken, queued_ms: float):
+        self.token = token
+        self.queued_ms = queued_ms
+        self.ctx = None                 # registered by PhysicalPlan.collect
+        self.deadline_timer: Optional[threading.Timer] = None
+
+    @property
+    def query_id(self) -> int:
+        return self.token.query_id
+
+    def arm_deadline(self, timeout_ms: Optional[float]) -> None:
+        """Deadline -> the SAME cancel event cancellation uses, so every
+        checkpoint/wait tests one flag. The timer thread only sets an
+        event — the query unwinds cooperatively at its next checkpoint."""
+        if timeout_ms is None or timeout_ms <= 0:
+            return
+        t = threading.Timer(
+            timeout_ms / 1000.0,
+            lambda: self.token.request_cancel("deadline exceeded"))
+        t.daemon = True
+        t.start()
+        self.deadline_timer = t
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        self.token.request_cancel(reason)
+
+
+class QueryManager:
+    """THE process-wide query scheduler (GpuSemaphore's missing other
+    half: admission at QUERY granularity). One instance per process
+    (:func:`get_query_manager`); resizable only while idle so tests can
+    reconfigure without racing in-flight queries."""
+
+    def __init__(self, max_concurrent: int = 2, queue_depth: int = 16,
+                 admission_timeout_ms: int = 60000):
+        self.max_concurrent = max(int(max_concurrent), 1)
+        self.queue_depth = max(int(queue_depth), 0)
+        self.admission_timeout_ms = max(int(admission_timeout_ms), 1)
+        self._lock = threading.Lock()
+        self._slots_free = self.max_concurrent
+        self._waiters: List[threading.Event] = []   # FIFO run queue
+        self._active: Dict[int, QueryTicket] = {}
+        self._next_id = 0
+
+    # -- admission -----------------------------------------------------------
+    def admit(self, conf=None,
+              cancel: Optional[threading.Event] = None) -> QueryTicket:
+        """Block until a run slot frees (FIFO), up to the admission
+        timeout; raise :class:`QueryRejectedError` immediately when the
+        queue is full (load shed) or on timeout. ``cancel`` (the
+        eventual query's cancel event, when the caller pre-creates it
+        for a handle) aborts the wait too — a queued query is
+        cancellable before it ever runs."""
+        from spark_rapids_tpu import config as C
+        tag = None
+        if conf is not None:
+            t = int(conf.get(C.TEST_FAULTS_QUERY_TAG))
+            if t >= 0:
+                tag = t
+        me: Optional[threading.Event] = None
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._slots_free > 0 and not self._waiters:
+                self._slots_free -= 1
+                return self._issue(tag, 0.0, cancel)
+            if len(self._waiters) >= self.queue_depth:
+                _record("rejected")
+                raise QueryRejectedError(
+                    f"run queue full ({len(self._waiters)} queued, "
+                    f"{self.max_concurrent} running)")
+            me = threading.Event()
+            self._waiters.append(me)
+        deadline = t0 + self.admission_timeout_ms / 1000.0
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0 or (cancel is not None and cancel.is_set()):
+                with self._lock:
+                    if me in self._waiters:
+                        self._waiters.remove(me)
+                    elif me.is_set():
+                        # Granted between the timeout and the lock: the
+                        # slot is ours to give back.
+                        self._release_slot_locked()
+                if cancel is not None and cancel.is_set():
+                    _record("cancelled")
+                    raise faults.QueryCancelledError(
+                        -1, "cancelled while queued")
+                _record("rejected")
+                raise QueryRejectedError(
+                    f"admission timeout after "
+                    f"{self.admission_timeout_ms}ms "
+                    f"({self.max_concurrent} running)")
+            if me.wait(min(remaining, 0.05)):
+                with self._lock:
+                    queued_ms = (time.perf_counter() - t0) * 1000.0
+                    return self._issue(tag, queued_ms, cancel)
+
+    def _issue(self, tag: Optional[int], queued_ms: float,
+               cancel: Optional[threading.Event]) -> QueryTicket:
+        """Build the admitted ticket (caller holds the lock / the slot)."""
+        self._next_id += 1
+        token = faults.QueryToken(self._next_id, tag)
+        if cancel is not None:
+            # The handle pre-created the cancel event (so cancel() works
+            # while still queued); the token adopts it.
+            token.cancel = cancel
+        ticket = QueryTicket(token, queued_ms)
+        self._active[token.query_id] = ticket
+        _record("admitted")
+        _record("queuedMs", queued_ms)
+        return ticket
+
+    def _release_slot_locked(self) -> None:
+        if self._waiters:
+            self._waiters.pop(0).set()      # hand the slot over, FIFO
+        else:
+            self._slots_free += 1
+
+    def finish(self, ticket: QueryTicket) -> None:
+        """Query teardown (success, failure, or cancel): release the run
+        slot, wake the next queued query, disarm the deadline."""
+        if ticket.deadline_timer is not None:
+            ticket.deadline_timer.cancel()
+        with self._lock:
+            self._active.pop(ticket.query_id, None)
+            self._release_slot_locked()
+
+    # -- isolation -----------------------------------------------------------
+    def register_context(self, ticket: QueryTicket, ctx) -> None:
+        """Attach the query's ExecContext so cross-query eviction can
+        reach its catalog (and only its catalog)."""
+        ticket.ctx = ctx
+
+    def evict_neighbors(self, requester_id: Optional[int]) -> int:
+        """Last-resort OOM rung BEFORE the batch-target shrink: spill
+        every OTHER active query's spillable device buffers to host.
+        The offending query's own buffers were already spilled by the
+        first two rungs — neighbors are only touched when that wasn't
+        enough. Returns bytes freed; every non-trivial eviction bumps
+        ``crossQueryEvictions``."""
+        with self._lock:
+            victims = [t for qid, t in self._active.items()
+                       if qid != requester_id and t.ctx is not None]
+        freed = 0
+        for t in victims:
+            catalog = getattr(t.ctx, "_catalog", None)
+            if catalog is None:
+                continue                # lazily unbuilt: nothing to spill
+            got = catalog.handle_oom()
+            if got > 0:
+                freed += got
+                _record("crossQueryEvictions")
+                faults.record("crossQueryEvictions")
+        return freed
+
+    @property
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    @property
+    def queued_count(self) -> int:
+        with self._lock:
+            return len(self._waiters)
+
+
+_MANAGER: Optional[QueryManager] = None
+_MANAGER_LOCK = threading.Lock()
+
+
+def _env_max_concurrent() -> Optional[int]:
+    v = os.environ.get("SRT_SCHEDULER_MAX_CONCURRENT", "").strip()
+    return int(v) if v else None
+
+
+def get_query_manager(conf=None) -> QueryManager:
+    """The process-wide manager. Sized from the first conf seen (like
+    the TPU semaphore) with the SRT_SCHEDULER_MAX_CONCURRENT env
+    override; re-sized from a later conf only while completely idle —
+    in-flight queries never see the bound change under them."""
+    from spark_rapids_tpu import config as C
+    global _MANAGER
+    want = None
+    if conf is not None:
+        want = (max(int(conf.get(C.SCHEDULER_MAX_CONCURRENT)), 1),
+                max(int(conf.get(C.SCHEDULER_QUEUE_DEPTH)), 0),
+                max(int(conf.get(C.SCHEDULER_ADMISSION_TIMEOUT_MS)), 1))
+        env = _env_max_concurrent()
+        if env is not None:
+            want = (max(env, 1),) + want[1:]
+    with _MANAGER_LOCK:
+        if _MANAGER is None:
+            if want is None:
+                env = _env_max_concurrent()
+                want = (max(env, 1) if env else 2, 16, 60000)
+            _MANAGER = QueryManager(*want)
+        elif want is not None and (
+                _MANAGER.max_concurrent, _MANAGER.queue_depth,
+                _MANAGER.admission_timeout_ms) != want:
+            with _MANAGER._lock:
+                idle = not _MANAGER._active and not _MANAGER._waiters
+            if idle:
+                _MANAGER = QueryManager(*want)
+        return _MANAGER
+
+
+def query_memory_fraction(conf, manager: QueryManager) -> float:
+    """Resolved fair-share fraction for one admitted query's catalog
+    budget: the explicit conf, or 1/maxConcurrentQueries when 0 (auto)
+    and queries can actually overlap."""
+    from spark_rapids_tpu import config as C
+    frac = float(conf.get(C.SCHEDULER_QUERY_MEMORY_FRACTION))
+    if frac <= 0:
+        frac = 1.0 / manager.max_concurrent
+    return min(max(frac, 0.01), 1.0)
+
+
+class QueryHandle:
+    """Async collect handle (``DataFrame.submit()``): the query runs on
+    a daemon worker thread; ``cancel()`` sets the shared cancel event —
+    effective both while queued (the admission wait aborts) and
+    mid-flight (the next dispatch checkpoint unwinds)."""
+
+    def __init__(self, run_collect, timeout_ms: Optional[float] = None):
+        self._cancel = threading.Event()
+        self._rows = None
+        self._error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+        def work():
+            try:
+                self._rows = run_collect(self._cancel, timeout_ms)
+            except BaseException as e:
+                self._error = e
+            finally:
+                self._done.set()
+
+        self._thread = threading.Thread(
+            target=work, daemon=True, name="srt-query")
+        self._thread.start()
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Rows on success; re-raises the query's error (including
+        QueryCancelledError / QueryRejectedError) on failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("query still running")
+        if self._error is not None:
+            raise self._error
+        return self._rows

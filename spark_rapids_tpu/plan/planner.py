@@ -427,16 +427,34 @@ class PhysicalPlan:
                 lines.append(f"  *Stage #{i} <{f.name}> fuses [{members}]")
         return "\n".join(lines)
 
-    def collect(self, ctx=None):
+    def collect(self, ctx=None, timeout_ms=None, cancel_event=None):
         import time as _time
 
         from spark_rapids_tpu import faults
         from spark_rapids_tpu.memory.oom import (
             backoff_delay_ms, is_transient_error, reset_degradation)
         from spark_rapids_tpu.ops.base import ExecContext, Metrics
+        from spark_rapids_tpu.parallel import scheduler as SC
         from spark_rapids_tpu.parallel import stages as S
         owned = ctx is None
-        ctx = ctx or ExecContext(self.conf)
+        # Multi-query admission (parallel/scheduler.py): one ticket per
+        # top-level collect. A thread already carrying a token (a nested
+        # collect issued by this same query — e.g. a gated write) rides
+        # the existing admission instead of deadlocking on a second
+        # slot. Caller-provided contexts are the caller's query.
+        ticket = None
+        mgr = None
+        if owned and faults.get_query_token() is None:
+            mgr = SC.get_query_manager(self.conf)
+            ticket = mgr.admit(self.conf, cancel=cancel_event)
+            ticket.arm_deadline(timeout_ms)
+            faults.set_query_token(ticket.token)
+        ctx = ctx or ExecContext(self.conf, query=ticket)
+        if ticket is not None:
+            mgr.register_context(ticket, ctx)
+            sched = SC.metrics_entry(ctx)
+            sched.add("admitted", 1)
+            sched.add("queuedMs", ticket.queued_ms)
         # Arm the fault schedule ONCE per query (not per attempt: a
         # retried attempt must run against the REMAINING schedule, or a
         # count-based transient fault re-fires forever), and clear any
@@ -486,6 +504,15 @@ class PhysicalPlan:
                 except Exception as e:
                     if not owned:
                         raise
+                    # Cancelled/deadlined queries unwind through every
+                    # retry rung: whatever error the cancellation
+                    # surfaced as (a killed stall, a poll raise, a torn
+                    # stream), the query is done — converting here also
+                    # stops the transient ladder from retrying it.
+                    if ticket is not None and ticket.token.cancelled():
+                        if not isinstance(e, faults.QueryCancelledError):
+                            raise ticket.token.error() from e
+                        raise
                     # Rung 1: lineage-scoped stage recompute.
                     st = S.stage_for_error(graph, e)
                     if st is not None and stage_recomputes < stage_budget:
@@ -522,12 +549,27 @@ class PhysicalPlan:
                             attempt + 1, max_retries, delay_ms, e)
                         _time.sleep(delay_ms / 1000.0)
                         ctx.close()
-                        ctx = ExecContext(self.conf)
+                        ctx = ExecContext(self.conf, query=ticket)
+                        if ticket is not None:
+                            mgr.register_context(ticket, ctx)
                     rec = ctx.metrics.setdefault(
                         "Recovery@query", Metrics(owner="Recovery"))
                     rec.add("retriesAttempted", 1)
                     attempt += 1
         finally:
+            if ticket is not None:
+                # Teardown accounting BEFORE the context close captures
+                # the leak report: cancelled vs deadline-killed.
+                if ticket.token.cancelled():
+                    sched = SC.metrics_entry(ctx)
+                    if ticket.token.reason == "deadline exceeded":
+                        sched.add("deadlineKills", 1)
+                        SC._record("deadlineKills")
+                    else:
+                        sched.add("cancelled", 1)
+                        SC._record("cancelled")
+                faults.set_query_token(None)
+                mgr.finish(ticket)
             # Metrics survive the collect for DataFrame.metrics().
             self.last_ctx = ctx
             if owned:

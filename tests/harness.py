@@ -15,10 +15,24 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from spark_rapids_tpu.benchmarks.compare import (     # noqa: F401
+    compare_results, first_mismatch, sort_key, values_close)
 from spark_rapids_tpu.columnar.host import HostBatch, host_to_device, \
     device_to_host
 from spark_rapids_tpu.exprs.base import (
     Expression, eval_exprs, eval_exprs_host)
+
+
+def assert_results_equal(got, want, sort: bool = False,
+                         rel_tol: float = 1e-6, abs_tol: float = 1e-9,
+                         msg: str = "oracle compare"):
+    """Generalized oracle comparison (BenchUtils.compareResults analog,
+    benchmarks/compare.py): sorted-rows option for computed-float ORDER
+    BY, dtype-aware epsilon (floats/dates), None-aware exact compare
+    elsewhere. The assertion message pinpoints the first divergence."""
+    bad = first_mismatch(got, want, sort=sort, rel_tol=rel_tol,
+                         abs_tol=abs_tol)
+    assert bad is None, f"{msg}: first mismatch {bad!r}"
 
 
 def assert_rows_equal(actual, expected, approx_float: bool = False,

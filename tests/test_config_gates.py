@@ -229,7 +229,12 @@ class TestFormatAndMemoryGates:
         df.collect()    # re-plan under the new conf version
         m = df.metrics()
         allowed = {"numOutputRows", "totalTime"}
-        assert m and all(set(v) <= allowed for v in m.values())
+        # Audit-trail entries (Recovery/Pipeline/Scheduler@query) are
+        # exempt from level filtering by contract — only the
+        # per-operator entries must be filtered down.
+        audit = {"Recovery@query", "Pipeline@query", "Scheduler@query"}
+        assert m and all(set(v) <= allowed for k, v in m.items()
+                         if k not in audit)
 
 
 def test_generated_docs_in_sync():

@@ -76,6 +76,40 @@ def test_mesh_repartition():
     assert got == want
 
 
+def test_mesh_shape_mismatch_degrades_observably(caplog):
+    """A mesh exchange whose partition count != mesh size must NOT be a
+    silent skip (or an assert): it degrades to the single-process
+    shuffle with a warning + the meshCollectiveSkipped counter, and the
+    results stay correct (ISSUE 5 satellite)."""
+    import logging
+
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.parallel.mesh_exchange import MeshExchangeExec
+    from spark_rapids_tpu.parallel.partitioning import HashPartitioning
+
+    s = _session(True)
+    q = _q_groupby(s)
+    phys = q._physical()
+
+    def rewrite(e):
+        # Force the shape mismatch: re-point every planned mesh
+        # exchange at a 3-way partitioning on the 8-device mesh.
+        if isinstance(e, MeshExchangeExec):
+            e.partitioning = HashPartitioning(
+                e.partitioning.keys, 3)
+        for c in e.children:
+            rewrite(c)
+    rewrite(phys.root)
+    faults.reset_counters()
+    with caplog.at_level(logging.WARNING, "spark_rapids_tpu"):
+        got = phys.collect()
+    want = _q_groupby(_session(False)).collect()
+    assert got == want
+    assert faults.counters().get("meshCollectiveSkipped", 0) >= 1
+    assert any("mesh collective skipped" in r.message
+               for r in caplog.records)
+
+
 def test_two_phase_sized_exchange(monkeypatch):
     """The sizes-then-data mesh shuffle (SURVEY 7 hard part 6): with the
     threshold lowered, the counts collective sizes the data all_to_all's
