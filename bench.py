@@ -265,6 +265,11 @@ def main():
         # the budget allows).
         "scheduler": {},
         "concurrency": {},
+        # Shuffle transport SPI (parallel/transport/): which transport
+        # served the run plus its byte/shard counters — nonzero
+        # remoteShardRefetches/remoteShardsLost say the run recovered
+        # from data-at-rest damage.
+        "transport": {},
     }
     with _LOCK:
         _STATE["out"] = out
@@ -381,6 +386,15 @@ def main():
                      "overlapRatio"):
             pl.setdefault(name, 0)
         out["pipeline"] = pl
+        from spark_rapids_tpu import config as _C
+        from spark_rapids_tpu.parallel import transport as _tp
+        tp = _tp.counters()
+        for name in ("transportBytesWritten", "transportBytesFetched",
+                     "transportShardsWritten", "transportShardsFetched",
+                     "remoteShardRefetches", "remoteShardsLost"):
+            tp.setdefault(name, 0)
+        tp["selected"] = _tp.transport_name(_C.TpuConf())
+        out["transport"] = tp
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

@@ -496,12 +496,15 @@ class DataFrame:
         # The Recovery@query entry (stageRecomputes, watchdogKills,
         # meshDegrades, retriesAttempted...), the Pipeline@query entry
         # (hostPrefetchMs, overlapRatio, pipelineStalls,
-        # concurrentStages...) and the Scheduler@query entry (queuedMs,
+        # concurrentStages...), the Scheduler@query entry (queuedMs,
         # admitted, cancelled, deadlineKills, crossQueryEvictions...)
-        # are audit trails — never filtered by verbosity level.
+        # and the Transport@query entry (transportBytesWritten/Fetched,
+        # remoteShardRefetches...) are audit trails — never filtered by
+        # verbosity level.
         return {k: {name: v for name, v in m.values.items()
                     if keep is None or name in keep
-                    or m.owner in ("Recovery", "Pipeline", "Scheduler")}
+                    or m.owner in ("Recovery", "Pipeline", "Scheduler",
+                                   "Transport")}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

@@ -526,6 +526,53 @@ TEST_FAULTS_QUERY_TAG = conf(
     "entries fire only on the query whose tag is N). -1 = untagged: "
     "the scheduler admission ordinal is the tag.").integer(-1)
 
+SHUFFLE_TRANSPORT = conf("spark.rapids.sql.shuffle.transport").doc(
+    "Shuffle transport SPI selection (parallel/transport/): 'inprocess' "
+    "(catalog-backed single-process exchange — today's default), 'mesh' "
+    "(ICI collective all_to_all over the device mesh; implies what "
+    "spark.rapids.sql.mesh.enabled used to select), or 'hostfile' "
+    "(shards spooled to a shared directory with a manifest/socket "
+    "rendezvous so independent worker processes can map-write and "
+    "reduce-fetch each other's shards — the DCN multi-slice stand-in). "
+    "Empty = inprocess unless SRT_SHUFFLE_TRANSPORT or the legacy "
+    "mesh.enabled key says otherwise. The reference's analog is the "
+    "RapidsShuffleInternalManager serializer fallback with the UCX "
+    "plugin behind it (GpuColumnarBatchSerializer.scala:38).").string("")
+
+SHUFFLE_TRANSPORT_HOSTFILE_DIR = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.dir").doc(
+    "Spool directory for the hostfile shuffle transport. All "
+    "cooperating worker processes must see the same path (a shared "
+    "filesystem is the stand-in for the DCN fabric). Empty = a "
+    "per-process directory under the system temp dir — correct for "
+    "single-process use, useless for cross-process rendezvous."
+).string("")
+
+SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.workerId").doc(
+    "This process's worker identity in the hostfile spool (manifest "
+    "name + shard subdirectory). Empty = 'w<pid>'.").string("")
+
+SHUFFLE_TRANSPORT_HOSTFILE_EXPECTED_WORKERS = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.expectedWorkers").doc(
+    "How many worker manifests a reduce-side fetch waits for before "
+    "serving shards (the membership half of the rendezvous). 1 = "
+    "single-process (fetch only this worker's shards).").integer(1)
+
+SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.rendezvous").doc(
+    "Optional 'host:port' of the socket rendezvous "
+    "(parallel/transport/rendezvous.py): committing workers announce "
+    "their manifest over TCP and fetchers block on the commit barrier "
+    "instead of polling the spool directory. Empty = manifest-file "
+    "polling only.").string("")
+
+SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.fetchTimeoutMs").doc(
+    "How long a reduce-side fetch waits for the expected worker "
+    "manifests before failing with a lost-shard error (which flows "
+    "into the recovery ladder).").integer(30000)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
@@ -686,6 +733,34 @@ def generate_docs() -> str:
         "partitionRetries, watchdogKills, meshDegrades,",
         "meshCollectiveSkipped, crossQueryEvictions) surface",
         "through `DataFrame.metrics()` and bench.py's JSON report.",
+        "",
+        "## Shuffle transport SPI",
+        "",
+        "`spark.rapids.sql.shuffle.transport` selects where shuffle",
+        "shards live (parallel/transport/, docs/shuffle.md):",
+        "",
+        "- `inprocess` (default) — the BufferCatalog-backed",
+        "  single-process exchange: shards are spillable catalog",
+        "  handles under the memory ladder.",
+        "- `mesh` — hash shuffles lower to `jax.lax.all_to_all`",
+        "  collectives over the device mesh (the ICI path; the legacy",
+        "  `spark.rapids.sql.mesh.enabled` key still selects it).",
+        "  Logical partition counts that differ from the mesh size FOLD",
+        "  onto devices (`meshPartitionFolds`) instead of degrading.",
+        "- `hostfile` — shards spool to a shared directory as",
+        "  CRC-framed blobs with a manifest/socket rendezvous",
+        "  (`shuffle.transport.hostfile.*` keys), so N independent",
+        "  worker processes can map-write and reduce-fetch each",
+        "  other's shards — the DCN multi-slice stand-in.",
+        "",
+        "All transports share the recovery contract: a lost or",
+        "persistently-corrupt shard raises owner-tagged and costs ONE",
+        "lineage-scoped stage recompute; a transiently-corrupt fetch",
+        "refetches once (`remoteShardRefetches`). The",
+        "`SRT_SHUFFLE_TRANSPORT` env overrides the default for a whole",
+        "process (the CI matrix hook), and `Transport@query` metrics +",
+        "bench.py's `transport` JSON block carry",
+        "`transportBytesWritten/Fetched` and the recovery counters.",
         "",
         "## Multi-query admission, isolation & cancellation",
         "",

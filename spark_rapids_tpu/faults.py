@@ -32,6 +32,11 @@ env)::
   threads and is re-raised at the ordered consumption point under the
   pipelined executor), ``exchange.flush`` / ``exchange.serve`` (shuffle
   map/reduce sides), ``mesh.exchange`` (collective shuffle),
+  ``transport`` / ``transport.write`` (shuffle-transport SPI fetch and
+  write funnels, parallel/transport/ — ``lostshard`` deletes the shard
+  at rest and raises owner-tagged, so recovery MUST recompute the
+  owning stage; ``corrupt`` flips a byte of the fetched frame, detected
+  by the CRC and refetched once, counter ``remoteShardRefetches``),
   ``spill.write`` / ``spill.read`` (disk tier I/O), ``wire``
   (serialized spill frames — corrupt only).
 - ``arg``: an integer N fires on the first N hits of the site (default
@@ -227,7 +232,8 @@ class FaultSpec:
         return f"FaultSpec({self.kind}@{self.site}{q}:{arg})"
 
 
-_KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall")
+_KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall",
+          "lostshard")
 
 
 class FaultParseError(ValueError):
@@ -495,6 +501,25 @@ def _stall(site: str) -> None:
     raise InjectedStallError(site)
 
 
+def check_fault(site: str, kinds) -> Optional[FaultSpec]:
+    """One hit of ``site`` against the armed schedule, restricted to
+    ``kinds``: returns the firing entry (recording the injection
+    counters) or None. The raw half of :func:`fault_point` for callers
+    that must act on the fired kind themselves — the shuffle-transport
+    fetch funnel uses it to delete the shard at rest before raising a
+    ``lostshard``, so recovery provably rewrites data instead of
+    re-reading it."""
+    inj = _INJECTOR
+    if inj is None:
+        return None
+    e = inj.should_fire(site, kinds, _current_fault_tag())
+    if e is None:
+        return None
+    record("faultsInjected")
+    record(f"faultsInjected.{e.kind}@{site}")
+    return e
+
+
 def fault_point(site: str, owner: Optional[int] = None) -> None:
     """Named injection site AND cancellation checkpoint. Checks the
     calling thread's query token first (a cancelled/deadlined query
@@ -505,15 +530,9 @@ def fault_point(site: str, owner: Optional[int] = None) -> None:
     the owning exchange exec's id so lineage recovery can invalidate
     exactly that stage's output."""
     check_cancelled()
-    inj = _INJECTOR
-    if inj is None:
-        return
-    e = inj.should_fire(site, ("oom", "transient", "lostoutput", "stall"),
-                        _current_fault_tag())
+    e = check_fault(site, ("oom", "transient", "lostoutput", "stall"))
     if e is None:
         return
-    record("faultsInjected")
-    record(f"faultsInjected.{e.kind}@{site}")
     if e.kind == "oom":
         raise InjectedOomError(site)
     if e.kind == "transient":

@@ -112,6 +112,49 @@ def _deserialize_bufs(blob: bytes, directory: list) -> list:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Self-describing shard wire format (the shuffle-transport SPI's at-rest
+# representation, parallel/transport/): ONE CRC-framed blob per shard,
+# meta + buffer directory as a JSON header followed by the contiguous
+# buffer bytes. The numpy round trip is bit-exact, so any transport that
+# moves these blobs (spool files today, a real DCN wire tomorrow)
+# preserves bit-identical query results by construction.
+# ---------------------------------------------------------------------------
+
+def batch_to_shard_blob(batch: DeviceBatch) -> bytes:
+    """DeviceBatch -> one CRC-framed, self-describing byte blob
+    (``wire.frame_blob`` on the outside, so fetch detects corruption at
+    the frame boundary)."""
+    import json
+    import struct
+
+    from spark_rapids_tpu.columnar.wire import frame_blob
+    meta, bufs = _batch_to_numpy(batch)
+    blob, directory = _serialize_bufs(bufs)
+    header = json.dumps(
+        {"meta": meta,
+         "directory": [{"dtype": d["dtype"],
+                        "shape": list(d["shape"]),
+                        "nbytes": d["nbytes"]} for d in directory]},
+    ).encode("utf-8")
+    return frame_blob(struct.pack("<I", len(header)) + header + blob)
+
+
+def shard_blob_to_batch(framed: bytes) -> DeviceBatch:
+    """Inverse of :func:`batch_to_shard_blob`. Raises
+    ``WireCorruptionError`` on any frame/CRC mismatch — wrong bytes must
+    never deserialize into wrong rows."""
+    import json
+    import struct
+
+    from spark_rapids_tpu.columnar.wire import unframe_blob
+    payload = unframe_blob(framed)
+    (hlen,) = struct.unpack_from("<I", payload)
+    header = json.loads(payload[4:4 + hlen].decode("utf-8"))
+    bufs = _deserialize_bufs(payload[4 + hlen:], header["directory"])
+    return _numpy_to_batch(header["meta"], bufs)
+
+
 @dataclasses.dataclass
 class BufferEntry:
     buffer_id: int

@@ -141,9 +141,16 @@ class ExecContext:
         parked in ``cache``): query teardown must free everything the
         query owned whether it succeeded, failed, or was cancelled."""
         from spark_rapids_tpu.memory.stores import SpillableBatch
+        from spark_rapids_tpu.parallel.transport.base import \
+            ShuffleSession
 
         def close_in(obj, depth: int = 0):
             if isinstance(obj, SpillableBatch):
+                obj.close()
+            elif isinstance(obj, ShuffleSession):
+                # Transport sessions (parallel/transport/) own their
+                # shards — catalog handles or spool files; teardown
+                # releases both.
                 obj.close()
             elif depth < 3 and isinstance(obj, (list, tuple)):
                 for x in obj:

@@ -1,14 +1,18 @@
 """Shuffle & broadcast exchanges (ref: GpuShuffleExchangeExec.scala:69,145,
 GpuBroadcastExchangeExec.scala:237, ShuffledBatchRDD.scala).
 
-Single-host execution model: the exchange materializes the child once per
-query context (the role Spark's shuffle files / the reference's
-RapidsCachingWriter device-store play — see RapidsShuffleInternalManager
-write path, SURVEY.md §3.4), bucketing every batch by partition id. Reduce
-tasks then stream their bucket. The multi-chip path replaces this
-materialization with an ICI all-to-all collective (parallel/mesh.py) — a
-planned collective exchange instead of a pull protocol, per SURVEY.md §2.6's
-TPU mapping note.
+The exchange materializes the child once per query context (the role
+Spark's shuffle files / the reference's RapidsCachingWriter device-store
+play — see RapidsShuffleInternalManager write path, SURVEY.md §3.4),
+bucketing every batch by partition id. Reduce tasks then stream their
+bucket. WHERE the buckets live is the shuffle transport SPI's business
+(parallel/transport/, ISSUE 6): ``inprocess`` keeps them as spillable
+catalog handles (single process), ``hostfile`` spools CRC-framed shard
+blobs to a shared directory so independent worker processes can fetch
+each other's map output, and the multi-chip path replaces this
+materialization entirely with an ICI all-to-all collective
+(parallel/mesh_exchange.py) — a planned collective exchange instead of a
+pull protocol, per SURVEY.md §2.6's TPU mapping note.
 
 A sampled range exchange computes bounds from a host sample first, like
 GpuRangePartitioner's reservoir sample.
@@ -228,17 +232,31 @@ class ShuffleExchangeExec(Exec):
                          (self._partitioning_fp(), piece_cap),
                          lambda: jax.jit(fn), metrics)
 
-    def _materialize_device(self, ctx) -> List[List[DeviceBatch]]:
+    def _open_session(self, ctx):
+        """Open this exchange's transport session (parallel/transport/):
+        the SPI decides where map-side shards live — catalog handles for
+        ``inprocess``, spool files for ``hostfile``. The session is the
+        durable stage output; it parks in ctx.cache so re-executions
+        serve the committed materialization and ctx.close tears it
+        down."""
+        import os
+
+        from spark_rapids_tpu.parallel import transport as T
+        transport = T.materialization_transport(ctx.conf)
+        return transport.open(
+            ctx.conf, f"x{os.getpid():x}-{id(self):x}",
+            self.partitioning.num_partitions, owner=id(self),
+            catalog=ctx.catalog, metrics=T.metrics_entry(ctx))
+
+    def _materialize_device(self, ctx):
         key = self._cache_key(True)
         if key in ctx.cache:
             return ctx.cache[key]
         self._ensure_bounds(ctx, device=True)
         n = self.partitioning.num_partitions
-        buckets: List[List[DeviceBatch]] = [[] for _ in range(n)]
+        sess = self._open_session(ctx)
         bucket_rows = [0] * n           # exact counts (AQE coalescing)
         from spark_rapids_tpu.columnar.batch import shrink_to_capacity
-        from spark_rapids_tpu.memory.stores import (
-            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
         pids_fn = self._pids_counts_fn(metrics=ctx.metrics_for(self))
         # Two-phase sizes-then-data (SURVEY §7): dispatch per-batch
         # partition-id counts, pull the whole window's counts in ONE
@@ -261,8 +279,8 @@ class ShuffleExchangeExec(Exec):
                     if cnt == 0:
                         continue
                     bucket_rows[0] += cnt
-                    buckets[0].append(SpillableBatch(
-                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+                    piece.rows_hint = cnt
+                    sess.write_shard(0, piece)
                 return
             metas = [(b,) + tuple(pids_fn(b)) for b in window]
             pulled = jax.device_get([m[2] for m in metas])
@@ -290,12 +308,12 @@ class ShuffleExchangeExec(Exec):
                         continue
                     piece.rows_hint = counts[p]
                     bucket_rows[p] += counts[p]
-                    # Shuffle output is spillable (RapidsCachingWriter
+                    # Shuffle output is durable (RapidsCachingWriter
                     # inserts into the device store; shuffle spills FIRST
-                    # per SpillPriorities) — the bucket holds a handle,
+                    # per SpillPriorities) — the transport session holds
+                    # a handle (spillable catalog entry or spool file),
                     # not a pinned device batch.
-                    buckets[p].append(SpillableBatch(
-                        ctx.catalog, piece, PRIORITY_SHUFFLE_OUTPUT))
+                    sess.write_shard(p, piece)
 
         # The window is bounded by BYTES as well as count: pre-split
         # batches are pinned un-spillable HBM, so a window must never
@@ -333,19 +351,19 @@ class ShuffleExchangeExec(Exec):
             if window:
                 flush_window(window)
         except BaseException:
-            # Partial materialization must not leak catalog entries: the
-            # planner's retry ladder (stage recompute / transient retry
-            # on the same context) re-runs this materialization from
-            # scratch, so whatever was bucketed so far is garbage.
-            for blist in buckets:
-                for sb in blist:
-                    sb.close()
+            # Partial materialization must not leak catalog entries or
+            # spool files: the planner's retry ladder (stage recompute /
+            # transient retry on the same context) re-runs this
+            # materialization from scratch, so whatever was written so
+            # far is garbage.
+            sess.abort()
             raise
         finally:
             pipe.close()
-        ctx.cache[key] = buckets
+        sess.commit()
+        ctx.cache[key] = sess
         ctx.cache[key + ":rows"] = bucket_rows
-        return buckets
+        return sess
 
     def _materialize_host(self, ctx) -> List[List[HostBatch]]:
         key = self._cache_key(False)
@@ -379,7 +397,7 @@ class ShuffleExchangeExec(Exec):
         from spark_rapids_tpu import config as C
         from spark_rapids_tpu.columnar.batch import jit_concat_batches
         from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
-        buckets = self._materialize_device(ctx)
+        sess = self._materialize_device(ctx)
         # Serve toward the (possibly OOM-degraded) batch target: after a
         # shrink escalation, reduce-side concats re-dispatch smaller.
         from spark_rapids_tpu.memory.oom import effective_batch_target
@@ -434,7 +452,7 @@ class ShuffleExchangeExec(Exec):
         mine = groups[partition] if groups is not None else [partition]
         try:
             for b in mine:
-              for sb in buckets[b]:
+              for sb in sess.fetch_shards(b):
                 if group and group_cap + sb.capacity > target:
                     yield from serve(group)
                     group, group_cap = [], 0
@@ -464,18 +482,19 @@ class ShuffleExchangeExec(Exec):
     # -- lineage recovery ----------------------------------------------------
     def stage_invalidate(self, ctx) -> None:
         """Drop this exchange's durable stage output (parallel/stages.py
-        boundary contract): close every bucket's catalog registration
-        and forget the materialization, so the next execution recomputes
-        this stage from its parents' still-cached outputs."""
+        boundary contract): the transport session releases every shard
+        it holds — catalog registrations, spool files — and the next
+        execution recomputes this stage from its parents' still-cached
+        outputs. Applies identically to a lost REMOTE shard: the
+        hostfile fetch raises owner-tagged, the planner lands here, and
+        the recompute rewrites the spool."""
         dev_key = self._cache_key(True)
-        buckets = ctx.cache.pop(dev_key, None)
+        sess = ctx.cache.pop(dev_key, None)
         ctx.cache.pop(dev_key + ":rows", None)
         ctx.cache.pop(self._cache_key(False), None)
         ctx.cache.pop(f"shuffle-groups:{id(self):x}", None)
-        if buckets:
-            for blist in buckets:
-                for sb in blist:
-                    sb.close()
+        if sess is not None:
+            sess.invalidate()
 
 
 class BroadcastExchangeExec(Exec):

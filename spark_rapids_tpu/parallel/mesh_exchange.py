@@ -161,9 +161,10 @@ class MeshExchangeExec(Exec):
         return self.partitioning.num_partitions
 
     def _pids_step(self, mesh):
-        """Per-shard partition ids, computed ONCE and fed to both the
-        counts and data collectives (murmur/bound-compare over every row
-        is not free twice)."""
+        """Per-shard LOGICAL partition ids, computed ONCE and fed to
+        both the counts and data collectives (murmur/bound-compare over
+        every row is not free twice). The collectives fold logical ids
+        onto device ids themselves (``pid // fold``)."""
         part = self.partitioning
 
         def local(stacked):
@@ -173,10 +174,10 @@ class MeshExchangeExec(Exec):
         return jax.jit(shard_map(local, mesh, in_specs=(P(M.DATA_AXIS),),
                                  out_specs=P(M.DATA_AXIS)))
 
-    def _build_step(self, mesh, n: int, piece_capacity=None):
+    def _build_step(self, mesh, n: int, fold: int, piece_capacity=None):
         def local(stacked, pids):
             b = tree_map(lambda x: x[0], stacked)
-            out = M.all_to_all_exchange(b, pids[0], n,
+            out = M.all_to_all_exchange(b, pids[0] // fold, n,
                                         piece_capacity=piece_capacity)
             return tree_map(lambda x: x[None], out)
 
@@ -184,10 +185,10 @@ class MeshExchangeExec(Exec):
             local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
             out_specs=P(M.DATA_AXIS)))
 
-    def _counts_step(self, mesh, n: int):
+    def _counts_step(self, mesh, n: int, fold: int):
         def local(stacked, pids):
             b = tree_map(lambda x: x[0], stacked)
-            return M.exchange_counts(b, pids[0], n)[None]
+            return M.exchange_counts(b, pids[0] // fold, n)[None]
 
         return jax.jit(shard_map(
             local, mesh, in_specs=(P(M.DATA_AXIS), P(M.DATA_AXIS)),
@@ -222,38 +223,49 @@ class MeshExchangeExec(Exec):
         ctx.metrics_for(self).add("meshDegrades", 1)
         ctx.cache["mesh.degraded"] = True
 
-    def _materialize(self, ctx) -> Optional[List]:
-        """Run the collective and register each device's post-exchange
-        shard as a durable stage output (spillable catalog handle).
-        Returns None after a graceful degrade — the caller serves from
-        the single-process fallback exchange instead."""
+    def _materialize(self, ctx):
+        """Run the collective and register each LOGICAL partition's
+        post-exchange shard as a durable stage output through the mesh
+        transport session (parallel/transport/mesh.py — spillable
+        catalog handles). Returns None after a graceful degrade — the
+        caller serves from the single-process fallback exchange
+        instead.
+
+        Partition count != mesh size no longer degrades: logical
+        partitions FOLD onto devices (``device = pid // ceil(np/n)``,
+        counter ``meshPartitionFolds``) and each device's received
+        shard splits back into its logical partitions after the
+        collective, so co-partitioned consumers never see mesh
+        geometry. ``meshCollectiveSkipped`` now fires only for
+        genuinely unsupported shapes (a non-jittable partitioning —
+        nothing the planner emits today)."""
         key = f"meshx:{id(self):x}"
         if key in ctx.cache:
             return ctx.cache[key]
         if ctx.cache.get(f"meshx-skip:{id(self):x}"):
-            return None         # shape mismatch already diagnosed once
+            return None         # unsupported shape already diagnosed
         m = ctx.metrics_for(self)
         mesh = mesh_for(ctx)
         n = mesh.devices.size
-        if n != self.partitioning.num_partitions:
-            # Shape mismatch (a conf-forced partition count, a mesh that
-            # shrank between planning and execution): the collective
-            # cannot run as one uniform shard per device. Degrade
-            # OBSERVABLY — warning + meshCollectiveSkipped counter +
-            # single-process fallback, matching the PR 3 degrade
-            # philosophy — instead of silently skipping (or asserting
-            # the query to death).
+        np_parts = self.partitioning.num_partitions
+        if np_parts < 1 or not getattr(self.partitioning, "jittable",
+                                       False):
             import logging
             from spark_rapids_tpu import faults
             logging.getLogger("spark_rapids_tpu").warning(
-                "mesh collective skipped in %s: partition count %d != "
-                "mesh size %d; serving this exchange from the "
+                "mesh collective skipped in %s: partitioning %r is not "
+                "collective-capable; serving this exchange from the "
                 "single-process shuffle path", self.name,
-                self.partitioning.num_partitions, n)
+                type(self.partitioning).__name__)
             faults.record("meshCollectiveSkipped")
             m.add("meshCollectiveSkipped", 1)
             ctx.cache[f"meshx-skip:{id(self):x}"] = True
             return None
+        fold = -(-np_parts // n)        # ceil: k logical pids per device
+        if fold > 1 or np_parts != n:
+            from spark_rapids_tpu import faults
+            faults.record("meshPartitionFolds")
+            m.add("meshPartitionFolds", 1)
         # Deal child partitions onto devices round-robin.
         per_dev: List[List[DeviceBatch]] = [[] for _ in range(n)]
         child = self.children[0]
@@ -284,15 +296,15 @@ class MeshExchangeExec(Exec):
                 if n > 1 and shards[0].capacity >= \
                         TWO_PHASE_MIN_SHARD_ROWS:
                     counts_fn = kc.lookup(
-                        "mesh-counts", mkey,
-                        lambda: self._counts_step(mesh, n), m)
+                        "mesh-counts", mkey + (fold,),
+                        lambda: self._counts_step(mesh, n, fold), m)
                     counts = np.asarray(counts_fn(stacked, pids))
                     piece_cap = bucket_capacity(max(int(counts.max()), 1))
                     if piece_cap >= shards[0].capacity:
                         piece_cap = None  # padding wouldn't shrink
                 step = kc.lookup(
-                    "mesh-exchange", mkey + (piece_cap,),
-                    lambda: self._build_step(mesh, n,
+                    "mesh-exchange", mkey + (fold, piece_cap),
+                    lambda: self._build_step(mesh, n, fold,
                                              piece_capacity=piece_cap), m)
                 out = step(stacked, pids)
                 parts = _addressable_parts(out, n)
@@ -301,42 +313,62 @@ class MeshExchangeExec(Exec):
                     raise
                 self._degrade(ctx, err)
                 return None
-        # Durable stage outputs: each shard registers with the buffer
-        # catalog (bounded by the memory ladder; CRC-framed once spilled
-        # to disk) instead of pinning raw HBM in ctx.cache.
-        from spark_rapids_tpu.memory.stores import (
-            PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
-        handles = [SpillableBatch(ctx.catalog, p, PRIORITY_SHUFFLE_OUTPUT)
-                   for p in parts]
-        ctx.cache[key] = handles
-        return handles
+        # Durable stage outputs through the transport SPI: each logical
+        # partition's shard registers with the buffer catalog (bounded
+        # by the memory ladder; CRC-framed once spilled to disk)
+        # instead of pinning raw HBM in ctx.cache.
+        from spark_rapids_tpu.parallel import transport as T
+        sess = T.get_transport("mesh").open(
+            ctx.conf, f"meshx-{id(self):x}", np_parts, owner=id(self),
+            catalog=ctx.catalog, metrics=T.metrics_entry(ctx))
+        if fold == 1 and np_parts <= n:
+            for p in range(np_parts):
+                sess.write_shard(p, parts[p])
+        else:
+            # Unfold: split each device's received shard back into its
+            # logical partitions (the pids recompute is one murmur pass
+            # over the received rows — received shards are dense, so
+            # this is row-proportional, not capacity-proportional).
+            for d in range(n):
+                lo = d * fold
+                cnt = min(np_parts - lo, fold)
+                if cnt <= 0:
+                    continue
+                shard = parts[d]
+                shard_pids = self.partitioning.partition_ids(shard)
+                live = shard.row_mask()
+                for j in range(cnt):
+                    keep = (shard_pids == lo + j) & live
+                    sess.write_shard(lo + j, shard.compact(keep))
+        sess.commit()
+        ctx.cache[key] = sess
+        return sess
 
     def execute_device(self, ctx, partition):
-        handles = None
+        sess = None
         if not ctx.cache.get("mesh.degraded"):
-            handles = self._materialize(ctx)
-        if handles is None:       # degraded (now or by a prior exchange)
+            sess = self._materialize(ctx)
+        if sess is None:          # degraded (now or by a prior exchange)
             yield from self._fallback().execute_device(ctx, partition)
             return
-        h = handles[partition]
-        batch = h.get()
-        try:
-            yield batch
-        finally:
-            from spark_rapids_tpu.memory.stores import \
-                PRIORITY_SHUFFLE_OUTPUT
-            h.release(PRIORITY_SHUFFLE_OUTPUT)
+        from spark_rapids_tpu.memory.stores import \
+            PRIORITY_SHUFFLE_OUTPUT
+        for h in sess.fetch_shards(partition):
+            batch = h.get()
+            try:
+                yield batch
+            finally:
+                h.release(PRIORITY_SHUFFLE_OUTPUT)
 
     # -- lineage recovery ----------------------------------------------------
     def stage_invalidate(self, ctx) -> None:
         """Drop this exchange's durable shards (stage boundary contract,
         parallel/stages.py)."""
-        handles = ctx.cache.pop(f"meshx:{id(self):x}", None)
+        sess = ctx.cache.pop(f"meshx:{id(self):x}", None)
         ctx.cache.pop(f"meshx-host:{id(self):x}", None)
         ctx.cache.pop(f"meshx-skip:{id(self):x}", None)
-        if handles:
-            for h in handles:
-                h.close()
+        if sess is not None:
+            sess.invalidate()
         fb = getattr(self, "_fallback_exec", None)
         if fb is not None:
             fb.stage_invalidate(ctx)

@@ -15,14 +15,22 @@ two pieces:
    the lineage edges recovery walks.
 
 2. **Durable, invalidatable stage outputs.** Every exchange registers its
-   materialization with the buffer catalog (``memory/stores.py``
-   SpillableBatch handles — bounded by the memory ladder, CRC-framed via
-   ``wire.frame_blob`` once spilled to disk) and exposes
+   materialization through the shuffle-transport SPI
+   (``parallel/transport/``): spillable catalog handles on the
+   ``inprocess``/``mesh`` transports (``memory/stores.py``
+   SpillableBatch — bounded by the memory ladder, CRC-framed via
+   ``wire.frame_blob`` once spilled to disk), CRC-framed spool files on
+   the cross-process ``hostfile`` transport — and exposes
    ``stage_invalidate(ctx)`` to drop it. Because re-running a collect on
    the SAME context serves every still-cached materialization instead of
    recomputing it, *invalidate-one-stage + re-collect* IS partition-scoped
    recovery: only the lost stage (and the never-materialized result
    stage above it) re-executes; sibling stages' scans never run again.
+   A lost or persistently-corrupt REMOTE shard behaves identically: the
+   transport fetch raises owner-tagged (``ShardLostError`` /
+   ``WireCorruptionError`` with ``fault_owner``), :func:`stage_for_error`
+   maps it to the owning exchange's stage, and the recompute REWRITES
+   the shard at rest — one stage, never a whole-query retry.
 
 The same DAG also powers the pipelined executor (parallel/pipeline.py,
 ISSUE 4): stages whose parents are all materialized are *independent*,

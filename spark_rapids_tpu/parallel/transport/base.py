@@ -1,0 +1,109 @@
+"""Shuffle transport SPI (ISSUE 6).
+
+The reference treats shuffle transport as a swappable layer: the
+columnar serializer fallback (GpuColumnarBatchSerializer.scala:38) works
+everywhere, and the UCX/RDMA plugin (shuffle-plugin/.../ucx/UCX.scala)
+slots in behind the same RapidsShuffleInternalManager interface when the
+fabric supports it. This package mirrors that split for the TPU engine:
+every exchange funnel talks to a :class:`ShuffleTransport` chosen by
+``spark.rapids.sql.shuffle.transport`` instead of hard-coding where
+shuffle shards live.
+
+Contract (see docs/shuffle.md for the full narrative):
+
+- ``Transport.open(conf, tag, ...)`` starts ONE map/reduce session for
+  one exchange materialization. ``tag`` identifies the exchange's
+  durable output (stable across a recompute of the same exchange).
+- ``session.write_shard(partition, batch)`` appends one map-side piece
+  to a reduce partition's shard list. Shards are owner-tagged with the
+  exchange id, so a loss detected at fetch time flows through
+  lineage-scoped stage recompute (parallel/stages.py), not whole-query
+  retry.
+- ``session.commit()`` publishes the map output atomically: fetches
+  must never observe a half-written shard set.
+- ``session.fetch_shards(partition)`` returns the partition's shard
+  handles (``.capacity``, ``.get() -> DeviceBatch``, ``.release()``,
+  ``.close()`` — the SpillableBatch protocol, memory/stores.py), in
+  deterministic map order.
+- ``session.invalidate()`` drops the durable output (the
+  ``stage_invalidate`` boundary contract) so a recompute rewrites it;
+  ``session.abort()`` cleans up a partial materialization;
+  ``session.close()`` is query teardown.
+
+Serialized shards are CRC-framed via the existing ``wire.frame_blob``
+format, so a flipped bit on any transport's at-rest data is DETECTED at
+fetch (one refetch, counter ``remoteShardRefetches``) instead of
+decoding into silently wrong rows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class ShardLostError(RuntimeError):
+    """A durable shuffle shard is gone (missing spool file, vanished
+    manifest, injected ``lostshard``). Carries the UNAVAILABLE marker so
+    an unattributable loss still lands in the whole-query retry, and
+    ``fault_owner`` (the owning exchange exec's id) so lineage recovery
+    (parallel/stages.py) can invalidate and recompute exactly the owning
+    stage instead."""
+
+    def __init__(self, what: str, owner: Optional[int] = None):
+        super().__init__(
+            f"UNAVAILABLE: lost shuffle shard: {what}")
+        self.fault_owner = owner
+
+
+class TransportError(RuntimeError):
+    """Non-recoverable transport misconfiguration (unknown transport
+    name, unreachable spool directory, rendezvous timeout)."""
+
+
+class ShuffleSession:
+    """One exchange materialization through one transport. Subclasses
+    implement the five SPI verbs; the base class only carries the
+    identity fields every implementation needs."""
+
+    def __init__(self, tag: str, owner: Optional[int]):
+        # ``tag`` names the durable output; ``owner`` is the owning
+        # exchange exec's id() — the lineage attribution every
+        # loss/corruption error must carry.
+        self.tag = tag
+        self.owner = owner
+
+    # -- map side ------------------------------------------------------------
+    def write_shard(self, partition: int, batch) -> None:
+        raise NotImplementedError
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    # -- reduce side ---------------------------------------------------------
+    def fetch_shards(self, partition: int) -> Sequence:
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------------
+    def abort(self) -> None:
+        """Failed mid-materialization: release whatever was written (the
+        retry ladder re-runs the materialization from scratch)."""
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Query teardown: release everything. Must be idempotent."""
+        self.invalidate()
+
+
+class ShuffleTransport:
+    """Transport factory. Stateless; one session per exchange
+    materialization."""
+
+    name = "?"
+
+    def open(self, conf, tag: str, num_partitions: int,
+             owner: Optional[int] = None, catalog=None,
+             metrics=None) -> ShuffleSession:
+        raise NotImplementedError

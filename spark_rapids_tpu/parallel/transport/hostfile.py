@@ -1,0 +1,328 @@
+"""Host-file / socket shuffle transport: the cross-process data plane
+(ISSUE 6 tentpole — the DCN multi-slice stand-in).
+
+Map side: every shard serializes to ONE self-describing CRC-framed blob
+(``memory/stores.batch_to_shard_blob`` — ``wire.frame_blob`` on the
+outside) and spools to a shared directory::
+
+    <dir>/<exchange-tag>/<worker>/p00003-0001.shard
+    <dir>/<exchange-tag>/<worker>.manifest.json     (atomic rename)
+
+``commit()`` publishes the manifest — shard files are invisible to
+fetchers until their manifest lands, so a fetch never observes a
+half-written map output — and, when a socket rendezvous is configured
+(``...hostfile.rendezvous``), announces the commit over TCP so fetchers
+block on the commit barrier instead of polling the directory.
+
+Reduce side: ``fetch_shards(p)`` waits for ``expectedWorkers``
+manifests, then serves partition p's shards in (worker, sequence) order
+— deterministic, so results are bit-identical to the in-process path.
+Fetched blobs re-upload and register with the query's BufferCatalog as
+spillable outputs (memory/stores.py), exactly like in-process buckets.
+
+Failure story (the reason this is a transport and not a file format):
+
+- a fetched frame failing its CRC re-reads ONCE (counter
+  ``remoteShardRefetches``) — injected corruption at rest recovers, a
+  persistently bad frame raises ``WireCorruptionError`` owner-tagged so
+  lineage recovery (parallel/stages.py) recomputes the owning stage;
+- a missing shard/manifest raises :class:`ShardLostError`, also
+  owner-tagged: one lost remote shard costs ONE stage recompute, never
+  a whole-query retry;
+- the ``lostshard@transport`` fault kind deletes the shard at rest
+  before raising, so chaos tests prove recovery REWRITES data rather
+  than re-reading a survivor.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.parallel.transport.base import (
+    ShardLostError, ShuffleSession, ShuffleTransport)
+
+_LOG = logging.getLogger("spark_rapids_tpu.transport")
+
+
+def default_spool_dir() -> str:
+    return os.path.join(tempfile.gettempdir(),
+                        f"srt_shuffle_{os.getpid()}")
+
+
+class HostFileShardHandle:
+    """Lazy shard handle with the SpillableBatch protocol: ``capacity``
+    is known from the manifest (no I/O), ``get()`` reads + verifies +
+    uploads on first use and serves the catalog-registered (spillable)
+    batch afterwards."""
+
+    def __init__(self, session: "HostFileSession", path: str,
+                 capacity: int, rows: Optional[int]):
+        self._session = session
+        self._path = path
+        self.capacity = capacity
+        self._rows = rows
+        self._sb = None          # SpillableBatch once fetched (catalog)
+        self._batch = None       # plain DeviceBatch (no catalog)
+        self._closed = False
+
+    def get(self):
+        if self._sb is not None:
+            return self._sb.get()
+        if self._batch is not None:
+            return self._batch
+        batch = self._session._fetch_blob(self._path)
+        if self._rows is not None and batch.rows_hint is None:
+            batch.rows_hint = self._rows
+        catalog = self._session._catalog
+        if catalog is not None:
+            from spark_rapids_tpu.memory.stores import (
+                PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+            self._sb = SpillableBatch(catalog, batch,
+                                      PRIORITY_SHUFFLE_OUTPUT)
+            return self._sb.get()
+        self._batch = batch
+        return batch
+
+    def release(self, priority: int = 0) -> None:
+        if self._sb is not None:
+            self._sb.release(priority)
+
+    def close(self) -> None:
+        if not self._closed:
+            if self._sb is not None:
+                self._sb.close()
+            self._sb = self._batch = None
+            self._closed = True
+
+
+class HostFileSession(ShuffleSession):
+    def __init__(self, conf, tag: str, num_partitions: int,
+                 owner: Optional[int], catalog, metrics):
+        super().__init__(tag, owner)
+        from spark_rapids_tpu import config as C
+        self._catalog = catalog
+        self._metrics = metrics
+        self.num_partitions = num_partitions
+        base = str(conf.get(C.SHUFFLE_TRANSPORT_HOSTFILE_DIR) or "") \
+            or default_spool_dir()
+        self.worker = str(conf.get(
+            C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID) or "") \
+            or f"w{os.getpid()}"
+        self.expected_workers = max(int(conf.get(
+            C.SHUFFLE_TRANSPORT_HOSTFILE_EXPECTED_WORKERS)), 1)
+        self.fetch_timeout_ms = int(conf.get(
+            C.SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS))
+        from spark_rapids_tpu.parallel.transport import rendezvous as RV
+        self._rv_addr = RV.parse_addr(str(conf.get(
+            C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS) or ""))
+        self.root = os.path.join(base, tag)
+        self._my_dir = os.path.join(self.root, self.worker)
+        self._seq: Dict[int, int] = {}
+        # This worker's manifest entries: partition -> [entry, ...]
+        self._written: Dict[int, List[dict]] = {}
+        self._committed = False
+        # Fetch-side caches: worker manifests + per-partition handles.
+        self._manifests: Optional[List[dict]] = None
+        self._handles: Dict[int, List[HostFileShardHandle]] = {}
+
+    # -- map side ------------------------------------------------------------
+    def write_shard(self, partition: int, batch) -> None:
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.memory.stores import batch_to_shard_blob
+        from spark_rapids_tpu.parallel import transport as T
+        faults.fault_point("transport.write", owner=self.owner)
+        blob = batch_to_shard_blob(batch)
+        seq = self._seq.get(partition, 0)
+        self._seq[partition] = seq + 1
+        os.makedirs(self._my_dir, exist_ok=True)
+        fname = f"p{partition:05d}-{seq:04d}.shard"
+        path = os.path.join(self._my_dir, fname)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        rows = batch.rows_hint
+        self._written.setdefault(partition, []).append(
+            {"file": f"{self.worker}/{fname}",
+             "capacity": int(batch.capacity),
+             "rows": None if rows is None else int(rows),
+             "bytes": len(blob)})
+        T.record("transportBytesWritten", len(blob))
+        T.record("transportShardsWritten")
+        if self._metrics is not None:
+            self._metrics.add("transportBytesWritten", len(blob))
+            self._metrics.add("transportShardsWritten", 1)
+
+    def commit(self) -> None:
+        os.makedirs(self.root, exist_ok=True)
+        manifest = {"worker": self.worker,
+                    "num_partitions": self.num_partitions,
+                    "shards": {str(p): entries
+                               for p, entries in self._written.items()}}
+        path = os.path.join(self.root, f"{self.worker}.manifest.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+        self._committed = True
+        if self._rv_addr is not None:
+            from spark_rapids_tpu.parallel.transport import rendezvous \
+                as RV
+            RV.announce_commit(self._rv_addr, self.tag, self.worker)
+
+    # -- reduce side ---------------------------------------------------------
+    def _load_manifests(self) -> List[dict]:
+        if self._manifests is not None:
+            return self._manifests
+        if self._rv_addr is not None:
+            from spark_rapids_tpu.parallel.transport import rendezvous \
+                as RV
+            RV.wait_committed(self._rv_addr, self.tag,
+                              self.expected_workers,
+                              self.fetch_timeout_ms)
+        deadline = time.monotonic() + self.fetch_timeout_ms / 1000.0
+        manifests: List[dict] = []
+        while True:
+            manifests = []
+            try:
+                names = sorted(os.listdir(self.root))
+            except FileNotFoundError:
+                names = []
+            for name in names:
+                if not name.endswith(".manifest.json"):
+                    continue
+                try:
+                    with open(os.path.join(self.root, name),
+                              encoding="utf-8") as f:
+                        manifests.append(json.load(f))
+                except (OSError, ValueError):
+                    continue      # racing writer; re-poll
+            if len(manifests) >= self.expected_workers:
+                break
+            if time.monotonic() >= deadline:
+                raise ShardLostError(
+                    f"exchange {self.tag}: {len(manifests)}/"
+                    f"{self.expected_workers} worker manifests in "
+                    f"{self.root} after {self.fetch_timeout_ms}ms",
+                    owner=self.owner)
+            time.sleep(0.02)
+        manifests.sort(key=lambda m: str(m.get("worker", "")))
+        self._manifests = manifests
+        return manifests
+
+    def fetch_shards(self, partition: int):
+        handles = self._handles.get(partition)
+        if handles is None:
+            handles = []
+            for m in self._load_manifests():
+                for entry in m.get("shards", {}).get(str(partition), []):
+                    handles.append(HostFileShardHandle(
+                        self, os.path.join(self.root, entry["file"]),
+                        int(entry["capacity"]), entry.get("rows")))
+            self._handles[partition] = handles
+        return handles
+
+    def _fetch_blob(self, path: str):
+        """Read + CRC-verify + upload one shard file; the transport
+        fault site and the refetch-once rung live here."""
+        from spark_rapids_tpu import faults
+        from spark_rapids_tpu.columnar.wire import WireCorruptionError
+        from spark_rapids_tpu.memory.stores import shard_blob_to_batch
+        from spark_rapids_tpu.parallel import transport as T
+        faults.check_cancelled()
+        e = faults.check_fault("transport",
+                               ("lostshard", "oom", "transient"))
+        if e is not None:
+            if e.kind == "oom":
+                raise faults.InjectedOomError("transport")
+            if e.kind == "transient":
+                raise faults.InjectedTransientError("transport")
+            # lostshard: delete the data at rest FIRST — recovery must
+            # rewrite the shard, not re-read a survivor.
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            T.record("remoteShardsLost")
+            raise ShardLostError(f"injected loss of {path}",
+                                 owner=self.owner)
+        last: Optional[WireCorruptionError] = None
+        for _ in range(2):
+            try:
+                with open(path, "rb") as f:
+                    framed = f.read()
+            except OSError as err:
+                T.record("remoteShardsLost")
+                raise ShardLostError(f"{path}: {err}",
+                                     owner=self.owner) from err
+            framed = faults.corrupt_blob("transport", framed)
+            try:
+                batch = shard_blob_to_batch(framed)
+            except WireCorruptionError as err:
+                last = err
+                faults.record("corruptionsDetected")
+                T.record("remoteShardRefetches")
+                faults.record("remoteShardRefetches")
+                _LOG.warning("shard frame checksum mismatch (%s), "
+                             "refetching: %s", path, err)
+                continue
+            T.record("transportBytesFetched", len(framed))
+            T.record("transportShardsFetched")
+            if self._metrics is not None:
+                self._metrics.add("transportBytesFetched", len(framed))
+                self._metrics.add("transportShardsFetched", 1)
+            return batch
+        # Persistently corrupt at rest: the durable output is gone.
+        # Owner-tag the failure so lineage recovery recomputes just the
+        # owning stage (the exchange.serve CRC contract, applied here).
+        last.fault_owner = self.owner
+        raise last
+
+    # -- lifecycle -----------------------------------------------------------
+    def _close_handles(self) -> None:
+        for hs in self._handles.values():
+            for h in hs:
+                h.close()
+        self._handles = {}
+        self._manifests = None
+
+    def invalidate(self) -> None:
+        """Drop the WHOLE durable output (stage recompute contract): a
+        recompute rewrites every worker's shards under the same tag."""
+        self._close_handles()
+        shutil.rmtree(self.root, ignore_errors=True)
+        self._written = {}
+        self._seq = {}
+        self._committed = False
+
+    def close(self) -> None:
+        """Query teardown: release fetched handles and remove what THIS
+        worker wrote. Other workers' spool data survives — their
+        sessions own it (cross-process fetches may still be running)."""
+        self._close_handles()
+        shutil.rmtree(self._my_dir, ignore_errors=True)
+        try:
+            os.remove(os.path.join(self.root,
+                                   f"{self.worker}.manifest.json"))
+        except OSError:
+            pass
+        try:
+            os.rmdir(self.root)   # last worker out turns off the lights
+        except OSError:
+            pass
+
+
+class HostFileTransport(ShuffleTransport):
+    name = "hostfile"
+
+    def open(self, conf, tag: str, num_partitions: int,
+             owner: Optional[int] = None, catalog=None,
+             metrics=None) -> HostFileSession:
+        return HostFileSession(conf, tag, num_partitions, owner,
+                               catalog, metrics)

@@ -1,0 +1,120 @@
+"""Lightweight socket rendezvous for the hostfile shuffle transport.
+
+The spool directory carries the DATA; this module carries the
+MEMBERSHIP signal: a committing worker announces "exchange X, worker W
+committed" over one short-lived TCP connection, and a reduce-side
+fetcher blocks until N distinct workers have committed an exchange —
+replacing manifest-file polling with an event wait (the metadata round
+of the reference's UCX transport, ~ActiveMessage registration, shrunk
+to one line of text).
+
+Wire protocol (UTF-8 lines, one request per connection):
+
+    COMMIT <exchange-tag> <worker-id>\n      -> OK\n
+    WAIT <exchange-tag> <n> <timeout-ms>\n   -> OK <k>\n | TIMEOUT <k>\n
+    LIST <exchange-tag>\n                    -> OK <w1,w2,...>\n
+    PING\n                                   -> OK\n
+
+The server is a few dozen lines on purpose: it coordinates, it never
+carries shard bytes, and losing it only degrades fetchers back to
+manifest polling.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Condition()
+        self.committed: Dict[str, Set[str]] = {}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        state: _State = self.server.state        # type: ignore[attr-defined]
+        line = self.rfile.readline().decode("utf-8", "replace").strip()
+        parts = line.split()
+        if not parts:
+            return
+        cmd = parts[0].upper()
+        if cmd == "PING":
+            self.wfile.write(b"OK\n")
+        elif cmd == "COMMIT" and len(parts) == 3:
+            _, tag, worker = parts
+            with state.lock:
+                state.committed.setdefault(tag, set()).add(worker)
+                state.lock.notify_all()
+            self.wfile.write(b"OK\n")
+        elif cmd == "LIST" and len(parts) == 2:
+            with state.lock:
+                ws = sorted(state.committed.get(parts[1], ()))
+            self.wfile.write(f"OK {','.join(ws)}\n".encode())
+        elif cmd == "WAIT" and len(parts) == 4:
+            _, tag, n_s, timeout_s = parts
+            n, timeout_ms = int(n_s), int(timeout_s)
+            deadline = time.monotonic() + timeout_ms / 1000.0
+            with state.lock:
+                while len(state.committed.get(tag, ())) < n:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    state.lock.wait(min(left, 0.2))
+                k = len(state.committed.get(tag, ()))
+            ok = b"OK" if k >= n else b"TIMEOUT"
+            self.wfile.write(ok + f" {k}\n".encode())
+        else:
+            self.wfile.write(b"ERR\n")
+
+
+class RendezvousServer:
+    """Threaded TCP rendezvous. ``addr`` is the bound (host, port) —
+    pass port 0 to let the OS pick one (tests)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.state = _State()            # type: ignore[attr-defined]
+        self.addr: Tuple[str, int] = self._srv.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="srt-rendezvous",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def _roundtrip(addr: Tuple[str, int], line: str,
+               timeout_s: float = 10.0) -> str:
+    with socket.create_connection(addr, timeout=timeout_s) as s:
+        s.sendall(line.encode("utf-8"))
+        f = s.makefile("rb")
+        return f.readline().decode("utf-8", "replace").strip()
+
+
+def parse_addr(spec: str) -> Optional[Tuple[str, int]]:
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    host, _, port = spec.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def announce_commit(addr: Tuple[str, int], tag: str, worker: str) -> None:
+    _roundtrip(addr, f"COMMIT {tag} {worker}\n")
+
+
+def wait_committed(addr: Tuple[str, int], tag: str, n: int,
+                   timeout_ms: int) -> bool:
+    """Block until ``n`` workers committed ``tag``; False on timeout."""
+    resp = _roundtrip(addr, f"WAIT {tag} {n} {timeout_ms}\n",
+                      timeout_s=timeout_ms / 1000.0 + 10.0)
+    return resp.startswith("OK")

@@ -644,6 +644,12 @@ class Planner:
     def _shuffle_partitions(self) -> int:
         if self._mesh_enabled():
             from spark_rapids_tpu.parallel.mesh_exchange import mesh_size
+            # An explicit partition-count conf wins: the mesh exchange
+            # folds/splits arbitrary logical partition counts onto the
+            # device mesh (MeshExchangeExec fold pass), so the user's
+            # fan-out no longer has to match the hardware shape.
+            if self.conf.raw.get(C.SHUFFLE_PARTITIONS.key) is not None:
+                return self.conf.get(C.SHUFFLE_PARTITIONS)
             return mesh_size()
         if self.conf.raw.get(C.SHUFFLE_PARTITIONS.key) is None:
             # Defaulted count on a single chip: a materialized exchange
@@ -658,7 +664,12 @@ class Planner:
         return self.conf.get(C.SHUFFLE_PARTITIONS)
 
     def _mesh_enabled(self) -> bool:
-        return bool(self.conf.get(C.MESH_ENABLED))
+        # Transport SPI selection (parallel/transport/): the 'mesh'
+        # transport lowers hash shuffles to MeshExchangeExec; everything
+        # else plans the materialized exchange, which spools through the
+        # selected transport at execution time.
+        from spark_rapids_tpu.parallel import transport as T
+        return T.transport_name(self.conf) == "mesh"
 
     def _hash_exchange(self, child: Exec, keys, n: int,
                        allow_coalesce: bool = False) -> Exec:
@@ -719,10 +730,14 @@ class Planner:
             if plan.keys:
                 keys = [resolve(k, plan.child.schema) for k in plan.keys]
                 if self._mesh_enabled():
+                    # The mesh exchange folds the requested partition
+                    # count onto the mesh, so the user's repartition
+                    # fan-out is honored as-is.
                     from spark_rapids_tpu.parallel.mesh_exchange import \
-                        MeshExchangeExec, mesh_size
+                        MeshExchangeExec
                     return MeshExchangeExec(
-                        child, HashPartitioning(keys, mesh_size())), \
+                        child,
+                        HashPartitioning(keys, plan.num_partitions)), \
                         want_dev
                 part = HashPartitioning(keys, plan.num_partitions)
             else:

@@ -1,0 +1,73 @@
+"""Worker-process half of the cross-process hostfile-transport test.
+
+Run as a standalone python process (NOT under the test's jax config):
+opens the shared spool directory as one independent worker, map-writes
+its deterministic slice of a two-column table as shards for every
+reduce partition, commits its manifest, and (when given a rendezvous
+address) announces the commit over the socket. The parent test process
+then reduce-fetches both workers' shards and asserts the union is
+bit-identical to the expected table — the DCN multi-slice stand-in
+demonstrated with real process isolation.
+
+Usage:
+    python hostfile_worker.py <spool_dir> <tag> <worker_id> \
+        <num_partitions> <rendezvous host:port | ->
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# Runs as a bare script from anywhere: the repo root (two levels up)
+# must be importable exactly like the parent test process sees it.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def worker_rows(worker_id: str, partition: int):
+    """Deterministic (key, value) rows this worker contributes to one
+    reduce partition — pure function of (worker, partition) so the
+    parent can compute the expected union without any IPC."""
+    w = int(worker_id[1:])          # "w0" -> 0
+    keys = [partition * 100 + w * 10 + i for i in range(5)]
+    vals = [k * 3 + 1 for k in keys]
+    return keys, vals
+
+
+def main() -> int:
+    spool, tag, worker_id, n_parts_s, rv = sys.argv[1:6]
+    n_parts = int(n_parts_s)
+
+    import numpy as np
+
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.host import (HostBatch, HostColumn,
+                                                host_to_device)
+    from spark_rapids_tpu.parallel.transport.hostfile import \
+        HostFileTransport
+
+    conf = C.TpuConf({
+        C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key: spool,
+        C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID.key: worker_id,
+        C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS.key:
+            "" if rv == "-" else rv,
+    })
+    sess = HostFileTransport().open(conf, tag, n_parts)
+    for p in range(n_parts):
+        keys, vals = worker_rows(worker_id, p)
+        hb = HostBatch(
+            ("k", "v"),
+            [HostColumn(dt.INT64, np.asarray(keys, np.int64),
+                        np.ones(len(keys), bool)),
+             HostColumn(dt.INT64, np.asarray(vals, np.int64),
+                        np.ones(len(vals), bool))])
+        sess.write_shard(p, host_to_device(hb))
+    sess.commit()
+    print(f"worker {worker_id} committed {n_parts} partitions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,97 @@ def test_metrics_surface_recovery_counters(data_dir):
 
 
 # ---------------------------------------------------------------------------
+# Shuffle-transport chaos (ISSUE 6): a lost/corrupt REMOTE shard on the
+# hostfile transport flows through lineage-scoped stage recompute — one
+# stage rewrites its spool, the query never whole-query-retries.
+# ---------------------------------------------------------------------------
+
+def _hostfile_session(chaos: str, spool: str) -> TpuSession:
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.shuffle.transport", "hostfile")
+    s.set("spark.rapids.sql.shuffle.transport.hostfile.dir", spool)
+    s.set("spark.rapids.sql.test.faults", chaos)
+    s.set("spark.rapids.sql.test.faults.seed", 7)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    return s
+
+
+def test_lost_remote_shard_recomputes_exactly_one_stage(
+        baselines, data_dir, tmp_path):
+    """``lostshard@transport`` deletes the shard at rest and raises
+    owner-tagged: recovery must invalidate ONLY the owning exchange's
+    stage, rewrite its spool, and produce bit-identical results — with
+    zero whole-query retries."""
+    from spark_rapids_tpu.parallel import transport as T
+    faults.reset_counters()
+    T.reset_counters()
+    got = tpch.QUERIES["q3"](
+        _hostfile_session("lostshard@transport:1", str(tmp_path)),
+        data_dir).collect()
+    assert got == baselines["q3"]
+    c = faults.counters()
+    assert c.get("faultsInjected.lostshard@transport") == 1, c
+    assert c.get("stageRecomputes") == 1, c
+    # The lineage detail names exactly ONE recomputed stage.
+    details = [k for k in c if k.startswith("stageRecomputes.stage")]
+    assert len(details) == 1 and c[details[0]] == 1, c
+    # Scoped recovery, not the whole-query rung.
+    assert c.get("retriesAttempted", 0) == 0, c
+    assert T.counters().get("remoteShardsLost") == 1
+
+
+def test_corrupt_remote_shard_refetches_without_recompute(
+        baselines, data_dir, tmp_path):
+    """``corrupt@transport:1`` flips a byte of one fetched frame: the
+    CRC detects it and ONE refetch recovers (the spool data is intact)
+    — no stage recompute, bit-identical results."""
+    from spark_rapids_tpu.parallel import transport as T
+    faults.reset_counters()
+    T.reset_counters()
+    got = tpch.QUERIES["q3"](
+        _hostfile_session("corrupt@transport:1", str(tmp_path)),
+        data_dir).collect()
+    assert got == baselines["q3"]
+    c = faults.counters()
+    assert c.get("faultsInjected.corrupt@transport") == 1, c
+    assert c.get("remoteShardRefetches") == 1, c
+    assert c.get("stageRecomputes", 0) == 0, c
+    assert T.counters().get("remoteShardRefetches") == 1
+
+
+def test_persistently_corrupt_shard_escalates_to_stage_recompute(
+        baselines, data_dir, tmp_path):
+    """``corrupt@transport:2`` corrupts the SAME shard's read and its
+    refetch: the data at rest is effectively gone, so the CRC failure
+    escalates owner-tagged to the stage-recompute rung, which rewrites
+    the spool — still bit-identical."""
+    faults.reset_counters()
+    got = tpch.QUERIES["q3"](
+        _hostfile_session("corrupt@transport:2", str(tmp_path)),
+        data_dir).collect()
+    assert got == baselines["q3"]
+    c = faults.counters()
+    assert c.get("corruptionsDetected", 0) >= 2, c
+    assert c.get("stageRecomputes") == 1, c
+
+
+def test_mixed_transport_schedule_bit_identical(baselines, data_dir,
+                                                tmp_path):
+    """Loss + corruption + a transient in one schedule, still
+    bit-identical through the layered recovery."""
+    faults.reset_counters()
+    got = tpch.QUERIES["q3"](
+        _hostfile_session(
+            "lostshard@transport:1,corrupt@transport:1,"
+            "transient@transport.write:1", str(tmp_path)),
+        data_dir).collect()
+    assert got == baselines["q3"]
+    c = faults.counters()
+    assert c.get("faultsInjected", 0) >= 3, c
+
+
+# ---------------------------------------------------------------------------
 # Escalation ladder unit tests: each rung fires, in order
 # ---------------------------------------------------------------------------
 

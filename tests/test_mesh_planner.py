@@ -76,13 +76,11 @@ def test_mesh_repartition():
     assert got == want
 
 
-def test_mesh_shape_mismatch_degrades_observably(caplog):
-    """A mesh exchange whose partition count != mesh size must NOT be a
-    silent skip (or an assert): it degrades to the single-process
-    shuffle with a warning + the meshCollectiveSkipped counter, and the
-    results stay correct (ISSUE 5 satellite)."""
-    import logging
-
+def test_mesh_shape_mismatch_folds_onto_mesh():
+    """A mesh exchange whose partition count != mesh size FOLDS the
+    logical partitions onto the devices (ISSUE 6 satellite — counter
+    meshPartitionFolds) instead of degrading to the single-process
+    shuffle; results stay correct partition-for-partition."""
     from spark_rapids_tpu import faults
     from spark_rapids_tpu.parallel.mesh_exchange import MeshExchangeExec
     from spark_rapids_tpu.parallel.partitioning import HashPartitioning
@@ -97,6 +95,43 @@ def test_mesh_shape_mismatch_degrades_observably(caplog):
         if isinstance(e, MeshExchangeExec):
             e.partitioning = HashPartitioning(
                 e.partitioning.keys, 3)
+        for c in e.children:
+            rewrite(c)
+    rewrite(phys.root)
+    faults.reset_counters()
+    got = phys.collect()
+    want = _q_groupby(_session(False)).collect()
+    assert got == want
+    c = faults.counters()
+    assert c.get("meshPartitionFolds", 0) >= 1
+    assert not c.get("meshCollectiveSkipped")
+    assert not c.get("meshDegrades")
+
+
+def test_mesh_unsupported_partitioning_degrades_observably(caplog):
+    """Shapes the collective genuinely cannot run (a non-jittable
+    partitioning) still degrade OBSERVABLY — warning +
+    meshCollectiveSkipped counter + single-process fallback — never a
+    silent skip or an assert."""
+    import logging
+
+    from spark_rapids_tpu import faults
+    from spark_rapids_tpu.parallel.mesh_exchange import MeshExchangeExec
+    from spark_rapids_tpu.parallel.partitioning import HashPartitioning
+
+    class HostBoundPartitioning(HashPartitioning):
+        @property
+        def jittable(self):
+            return False
+
+    s = _session(True)
+    q = _q_groupby(s)
+    phys = q._physical()
+
+    def rewrite(e):
+        if isinstance(e, MeshExchangeExec):
+            e.partitioning = HostBoundPartitioning(
+                e.partitioning.keys, e.partitioning.num_partitions)
         for c in e.children:
             rewrite(c)
     rewrite(phys.root)

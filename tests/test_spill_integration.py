@@ -24,6 +24,10 @@ def test_groupby_spills_and_stays_correct():
     # ~8 KiB budget: below even one exchange's bucket total, so buckets
     # spill host-ward DURING materialization and must restore on read.
     s.set("spark.rapids.memory.tpu.budgetBytes", 8 * 1024)
+    # This asserts the IN-PROCESS transport's map-side spill behavior
+    # (hostfile map shards live in spool files, not the catalog), so
+    # pin the transport against the SRT_SHUFFLE_TRANSPORT matrix env.
+    s.set("spark.rapids.sql.shuffle.transport", "inprocess")
     q = _df(s).group_by("k").agg(agg_sum(col("v")).alias("sv"),
                                  agg_count().alias("n")).order_by("k")
     phys = q._physical()
@@ -38,18 +42,24 @@ def test_groupby_spills_and_stays_correct():
 
 
 def test_no_raw_batches_in_cache():
-    """ctx.cache holds spillable handles, not pinned device batches."""
-    from spark_rapids_tpu.columnar.batch import DeviceBatch
+    """ctx.cache holds transport sessions whose shards are spillable
+    handles, not pinned device batches."""
     from spark_rapids_tpu.memory.stores import SpillableBatch
+    from spark_rapids_tpu.parallel.transport.base import ShuffleSession
     s = TpuSession()
     q = _df(s).group_by("k").agg(agg_count().alias("n"))
     phys = q._physical()
     ctx = ExecContext(phys.conf)
     phys.root.collect(ctx, device=True)
+    seen = 0
     for key, val in ctx.cache.items():
         if key.startswith("shuffle:") and not key.endswith(":rows"):
-            for bucket in val:
+            assert isinstance(val, ShuffleSession), \
+                f"raw materialization hoarded in {key}"
+            seen += 1
+            for bucket in getattr(val, "buckets", []):
                 for item in bucket:
                     assert isinstance(item, SpillableBatch), \
                         f"raw batch hoarded in {key}"
+    assert seen >= 1
     ctx.close()

@@ -1,0 +1,278 @@
+"""Shuffle transport SPI (ISSUE 6): selection, shard wire format,
+hostfile spool/manifest/rendezvous semantics, and the cross-process
+demonstration — two independent worker processes map-write shards that
+the parent reduce-fetches through the same SPI.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.host import (HostBatch, HostColumn,
+                                            device_to_host, host_to_device)
+from spark_rapids_tpu.memory.stores import (batch_to_shard_blob,
+                                            shard_blob_to_batch)
+from spark_rapids_tpu.parallel import transport as T
+from spark_rapids_tpu.parallel.transport.base import ShardLostError
+from spark_rapids_tpu.parallel.transport.hostfile import HostFileTransport
+from spark_rapids_tpu.parallel.transport import rendezvous as RV
+
+
+def _batch(keys, vals):
+    hb = HostBatch(
+        ("k", "v"),
+        [HostColumn(dt.INT64, np.asarray(keys, np.int64),
+                    np.ones(len(keys), bool)),
+         HostColumn(dt.INT64, np.asarray(vals, np.int64),
+                    np.ones(len(vals), bool))])
+    return host_to_device(hb)
+
+
+def _rows(batch):
+    return device_to_host(batch).to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# Selection
+# ---------------------------------------------------------------------------
+
+def test_transport_selection_conf_env_legacy(monkeypatch):
+    monkeypatch.delenv("SRT_SHUFFLE_TRANSPORT", raising=False)
+    assert T.transport_name(C.TpuConf()) == "inprocess"
+    assert T.transport_name(C.TpuConf(
+        {C.SHUFFLE_TRANSPORT.key: "hostfile"})) == "hostfile"
+    # Legacy mesh.enabled key still selects the mesh transport.
+    assert T.transport_name(C.TpuConf(
+        {C.MESH_ENABLED.key: True})) == "mesh"
+    # Env is a whole-process default; explicit conf wins over it.
+    monkeypatch.setenv("SRT_SHUFFLE_TRANSPORT", "hostfile")
+    assert T.transport_name(C.TpuConf()) == "hostfile"
+    assert T.transport_name(C.TpuConf(
+        {C.SHUFFLE_TRANSPORT.key: "inprocess"})) == "inprocess"
+    with pytest.raises(T.TransportError):
+        T.transport_name(C.TpuConf({C.SHUFFLE_TRANSPORT.key: "ucx"}))
+
+
+def test_register_third_party_transport():
+    class Fake(T.ShuffleTransport):
+        name = "fake"
+    T.register_transport("fake", Fake)
+    try:
+        assert isinstance(T.get_transport("fake"), Fake)
+        assert T.transport_name(C.TpuConf(
+            {C.SHUFFLE_TRANSPORT.key: "fake"})) == "fake"
+    finally:
+        T._REGISTRY.pop("fake", None)
+        T._INSTANCES.pop("fake", None)
+
+
+# ---------------------------------------------------------------------------
+# Shard wire format
+# ---------------------------------------------------------------------------
+
+def test_shard_blob_roundtrip_bit_exact():
+    b = _batch([1, 2, 3, -7], [10, 20, 30, 40])
+    out = shard_blob_to_batch(batch_to_shard_blob(b))
+    assert _rows(out) == _rows(b)
+    assert out.capacity == b.capacity
+
+
+def test_shard_blob_detects_corruption():
+    from spark_rapids_tpu.columnar.wire import WireCorruptionError
+    blob = bytearray(batch_to_shard_blob(_batch([1], [2])))
+    blob[len(blob) // 2] ^= 0xFF
+    with pytest.raises(WireCorruptionError):
+        shard_blob_to_batch(bytes(blob))
+
+
+# ---------------------------------------------------------------------------
+# Hostfile transport (single process)
+# ---------------------------------------------------------------------------
+
+def _hostfile_conf(tmp_path, **over):
+    raw = {C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key: str(tmp_path)}
+    raw.update({getattr(C, k).key: v for k, v in over.items()})
+    return C.TpuConf(raw)
+
+
+def test_hostfile_write_commit_fetch_roundtrip(tmp_path):
+    conf = _hostfile_conf(tmp_path)
+    w = HostFileTransport().open(conf, "xround", 2, owner=123)
+    w.write_shard(0, _batch([1, 2], [3, 4]))
+    w.write_shard(1, _batch([5], [6]))
+    w.write_shard(0, _batch([7], [8]))
+    w.commit()
+    r = HostFileTransport().open(conf, "xround", 2)
+    got0 = [row for h in r.fetch_shards(0) for row in _rows(h.get())]
+    got1 = [row for h in r.fetch_shards(1) for row in _rows(h.get())]
+    assert got0 == [(1, 3), (2, 4), (7, 8)]    # (worker, seq) order
+    assert got1 == [(5, 6)]
+    assert r.fetch_shards(1)[0].capacity >= 1  # manifest-known, no I/O
+    r.close()
+    w.close()
+    assert not os.path.exists(w.root)          # last worker cleaned up
+
+
+def test_hostfile_fetch_waits_for_commit(tmp_path):
+    conf = _hostfile_conf(
+        tmp_path, SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS=200)
+    w = HostFileTransport().open(conf, "xuncommitted", 1, owner=9)
+    w.write_shard(0, _batch([1], [2]))
+    # No commit: the manifest is the publication barrier, so a fetch
+    # sees NOTHING (not a torn shard set) and times out lost.
+    r = HostFileTransport().open(conf, "xuncommitted", 1, owner=9)
+    with pytest.raises(ShardLostError) as ei:
+        r.fetch_shards(0)
+    assert ei.value.fault_owner == 9
+    w.invalidate()
+
+
+def test_hostfile_lost_shard_raises_owner_tagged(tmp_path):
+    conf = _hostfile_conf(tmp_path)
+    w = HostFileTransport().open(conf, "xlost", 1, owner=42)
+    w.write_shard(0, _batch([1], [2]))
+    w.commit()
+    # The shard vanishes at rest (a dead worker, a reaped spool).
+    for root, _, files in os.walk(w.root):
+        for f in files:
+            if f.endswith(".shard"):
+                os.remove(os.path.join(root, f))
+    r = HostFileTransport().open(conf, "xlost", 1, owner=42)
+    with pytest.raises(ShardLostError) as ei:
+        r.fetch_shards(0)[0].get()
+    assert ei.value.fault_owner == 42          # -> stage recompute
+    w.invalidate()
+
+
+def test_hostfile_corrupt_at_rest_refetches_once(tmp_path):
+    T.reset_counters()
+    conf = _hostfile_conf(tmp_path)
+    w = HostFileTransport().open(conf, "xcorrupt", 1, owner=7)
+    w.write_shard(0, _batch([1, 2, 3], [4, 5, 6]))
+    w.commit()
+    faults.configure("corrupt@transport:1", seed=3)
+    try:
+        r = HostFileTransport().open(conf, "xcorrupt", 1, owner=7)
+        got = _rows(r.fetch_shards(0)[0].get())
+        assert got == [(1, 4), (2, 5), (3, 6)]
+        assert T.counters().get("remoteShardRefetches") == 1
+    finally:
+        faults.configure("")
+        w.invalidate()
+
+
+def test_hostfile_invalidate_drops_spool(tmp_path):
+    conf = _hostfile_conf(tmp_path)
+    w = HostFileTransport().open(conf, "xinval", 1, owner=1)
+    w.write_shard(0, _batch([1], [2]))
+    w.commit()
+    assert os.path.isdir(w.root)
+    w.invalidate()
+    assert not os.path.exists(w.root)
+    # A recompute rewrites the same tag from scratch.
+    w.write_shard(0, _batch([9], [10]))
+    w.commit()
+    r = HostFileTransport().open(conf, "xinval", 1)
+    assert _rows(r.fetch_shards(0)[0].get()) == [(9, 10)]
+    w.invalidate()
+
+
+# ---------------------------------------------------------------------------
+# Cross-process: 2 independent worker processes + socket rendezvous
+# ---------------------------------------------------------------------------
+
+def test_hostfile_cross_process_two_workers(tmp_path):
+    """Two separate python processes map-write shards into the shared
+    spool (announcing over the socket rendezvous); this process
+    reduce-fetches their union through the same SPI — the multi-slice
+    DCN stand-in with real process isolation."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "fixtures"))
+    try:
+        from hostfile_worker import worker_rows
+    finally:
+        sys.path.pop(0)
+    script = os.path.join(os.path.dirname(__file__), "fixtures",
+                          "hostfile_worker.py")
+    n_parts = 3
+    srv = RV.RendezvousServer()
+    rv = f"{srv.addr[0]}:{srv.addr[1]}"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)        # workers need no 8-device mesh
+    try:
+        procs = [subprocess.Popen(
+            [sys.executable, script, str(tmp_path), "xproc", w,
+             str(n_parts), rv],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for w in ("w0", "w1")]
+        conf = _hostfile_conf(
+            tmp_path,
+            SHUFFLE_TRANSPORT_HOSTFILE_EXPECTED_WORKERS=2,
+            SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS=rv,
+            SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS=120000)
+        r = HostFileTransport().open(conf, "xproc", n_parts)
+        for p in range(n_parts):
+            got = [row for h in r.fetch_shards(p)
+                   for row in _rows(h.get())]
+            want = []
+            for w in ("w0", "w1"):     # manifest (worker) order
+                keys, vals = worker_rows(w, p)
+                want += list(zip(keys, vals))
+            assert got == want, f"partition {p} diverged"
+        for pr in procs:
+            out, _ = pr.communicate(timeout=120)
+            assert pr.returncode == 0, out.decode()
+        r.close()
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Query-level parity (integer agg => bit-identical across transports)
+# ---------------------------------------------------------------------------
+
+def _parity_query(session, data_dir):
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+    a = session.read.parquet(os.path.join(data_dir, "t.parquet"))
+    b = session.read.parquet(os.path.join(data_dir, "d.parquet"))
+    j = a.join_on(b, ["k"], ["k2"], strategy="shuffle")
+    return j.group_by("k").agg(
+        agg_sum(col("v") + col("w")).alias("s")).order_by(col("k").asc())
+
+
+@pytest.fixture(scope="module")
+def parity_dir(tmp_path_factory):
+    import pandas as pd
+    d = tmp_path_factory.mktemp("transport_parity")
+    rng = np.random.default_rng(11)
+    pd.DataFrame({
+        "k": rng.integers(0, 40, 4000),
+        "v": rng.integers(0, 10**6, 4000),
+    }).to_parquet(str(d / "t.parquet"))
+    pd.DataFrame({
+        "k2": np.arange(40),
+        "w": rng.integers(0, 10**6, 40),
+    }).to_parquet(str(d / "d.parquet"))
+    return str(d)
+
+
+@pytest.mark.parametrize("transport", ["inprocess", "mesh", "hostfile"])
+def test_join_agg_bit_identical_across_transports(transport, parity_dir,
+                                                  tmp_path):
+    from spark_rapids_tpu.api.dataframe import TpuSession
+
+    def run(name):
+        s = TpuSession()
+        s.set("spark.rapids.sql.shuffle.transport", name)
+        s.set(C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key, str(tmp_path))
+        return _parity_query(s, parity_dir).collect()
+
+    # Integer aggregation: no float-summation-order wiggle room — all
+    # three transports must agree to the BIT.
+    assert run(transport) == run("inprocess")
