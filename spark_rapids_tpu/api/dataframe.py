@@ -497,14 +497,15 @@ class DataFrame:
         # meshDegrades, retriesAttempted...), the Pipeline@query entry
         # (hostPrefetchMs, overlapRatio, pipelineStalls,
         # concurrentStages...), the Scheduler@query entry (queuedMs,
-        # admitted, cancelled, deadlineKills, crossQueryEvictions...)
-        # and the Transport@query entry (transportBytesWritten/Fetched,
-        # remoteShardRefetches...) are audit trails — never filtered by
-        # verbosity level.
+        # admitted, cancelled, deadlineKills, crossQueryEvictions...),
+        # the Transport@query entry (transportBytesWritten/Fetched,
+        # remoteShardRefetches...) and the Cost@query entry (placements,
+        # replanChecks, joinDemotions, estimateErrorPct...) are audit
+        # trails — never filtered by verbosity level.
         return {k: {name: v for name, v in m.values.items()
                     if keep is None or name in keep
                     or m.owner in ("Recovery", "Pipeline", "Scheduler",
-                                   "Transport")}
+                                   "Transport", "Cost")}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

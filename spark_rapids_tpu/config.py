@@ -153,6 +153,66 @@ AQE_COALESCE_TARGET_ROWS = conf(
     "spark.rapids.sql.aqe.coalescePartitions.targetRows").doc(
     "Row target per post-shuffle partition when coalescing.").long(1 << 20)
 
+AQE_COALESCE_TARGET_BYTES = conf(
+    "spark.rapids.sql.aqe.coalescePartitions.targetBytes").doc(
+    "Byte target per post-shuffle partition when coalescing, from the "
+    "OBSERVED shard bytes the transport session recorded at "
+    "materialization (the exact-size half of "
+    "GpuCustomShuffleReaderExec's coalesced reader). Partitions merge "
+    "while both the row and the byte target hold.").long(64 * 1024 * 1024)
+
+AQE_REPLAN = conf("spark.rapids.sql.aqe.replan.enabled").doc(
+    "Runtime adaptive re-planning (parallel/replan.py): before stage "
+    "prematerialization, materialize each shuffled hash join's "
+    "build-side exchange, read the OBSERVED partition byte sizes from "
+    "its transport session, and when the build side fits "
+    "autoBroadcastJoinThreshold demote the join to a broadcast hash "
+    "join — the probe side then skips its shuffle entirely and the "
+    "fusion pass re-runs over the rewritten subtree. Extends the "
+    "stats-only AQE-lite into true mid-query re-planning "
+    "(GpuCustomShuffleReaderExec.scala:132 analog driven by the stage "
+    "DAG). Off keeps the statically planned joins.").boolean(True)
+
+COST_ENABLED = conf("spark.rapids.sql.cost.enabled").doc(
+    "Cost-based host/device placement (plan/cost.py): estimate every "
+    "logical subtree's device time (compile-amortized sync floor + "
+    "bytes over the device pipeline) and host time (bytes over the "
+    "host engine) from parquet/ORC footer stats, and place whole "
+    "maximal subtrees on the host engine when the host estimate wins — "
+    "small inputs cannot amortize the ~70-100ms per-dispatch sync "
+    "floor of a tunneled chip (the reference's own 'worthwhile >=30s' "
+    "economics, docs/FAQ.md:82-84). The SRT_COST env (0/1) overrides "
+    "the default for a whole process. Placement is skipped in test "
+    "mode, under an armed fault schedule, and on non-inprocess shuffle "
+    "transports (chaos/mesh paths pin the device plan).").boolean(True)
+
+COST_SYNC_FLOOR_MS = conf("spark.rapids.sql.cost.deviceSyncFloorMs").doc(
+    "Calibrated cost of ONE device host-sync round trip (the sizes "
+    "pull / result fetch floor a tunneled chip pays per dispatch "
+    "funnel; the r4 q3 profile measured ~70-100ms). Every "
+    "sync-bearing node (exchange, join build, aggregate shrink, sort "
+    "sample, collect download) charges multiples of this.").double(80.0)
+
+COST_DEVICE_GBPS = conf("spark.rapids.sql.cost.deviceThroughputGBps").doc(
+    "Calibrated steady-state device pipeline throughput (decode + "
+    "upload + kernels with the scan cache warm) used for the "
+    "bytes-proportional term of the device estimate.").double(2.0)
+
+COST_HOST_GBPS = conf("spark.rapids.sql.cost.hostThroughputGBps").doc(
+    "Calibrated host (numpy) engine throughput per operator pass used "
+    "for the bytes-proportional term of the host estimate.").double(0.6)
+
+COST_MAX_HOST_BYTES = conf("spark.rapids.sql.cost.maxHostBytes").doc(
+    "Safety ceiling: a subtree whose estimated input exceeds this many "
+    "bytes is never host-placed regardless of the model (the host "
+    "engine is single-process numpy; past this size the device always "
+    "wins once syncs amortize).").long(256 * 1024 * 1024)
+
+COST_EXPLAIN = conf("spark.rapids.sql.cost.explain").doc(
+    "Render per-node cost estimates (rows/bytes, device-ms vs host-ms, "
+    "sync counts) and the chosen placement in DataFrame.explain() "
+    "output.").boolean(False)
+
 AGG_SKIP_PARTIAL_RATIO = conf(
     "spark.rapids.sql.agg.skipAggPassReductionRatio").doc(
     "When the first partial-aggregation batch reduces its input by less "
@@ -360,8 +420,13 @@ KERNEL_CACHE_MAX_ENTRIES = conf(
     "(expression fingerprint, input schema, capacity bucket). Repeated "
     "queries — bench iterations, suite partitions, serving traffic — "
     "reuse compiled programs across planner/exec instances instead of "
-    "re-tracing them; the bound caps host memory held by cached "
-    "executables.").integer(1024)
+    "re-tracing them; the bound caps host memory AND mmap regions held "
+    "by cached executables. The latter is the binding constraint: a "
+    "live XLA CPU executable for a real query kernel holds ~80 memory "
+    "maps, and Linux caps a process at vm.max_map_count (65530 by "
+    "default) — cross it and the next compile SIGSEGVs inside XLA. 512 "
+    "keeps a fully-fat cache near ~40k maps; raise it only with a "
+    "raised map ceiling.").integer(512)
 
 DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
     "Explicit HBM budget for the buffer catalog in bytes; 0 derives it "
@@ -789,6 +854,36 @@ def generate_docs() -> str:
         "neighbors. `SRT_SCHEDULER_MAX_CONCURRENT=1` degenerates to",
         "strictly serial queries, byte-identical to the pre-scheduler",
         "engine. See docs/robustness.md and tests/test_scheduler.py.",
+        "",
+        "## Cost-based placement & adaptive re-planning",
+        "",
+        "With `spark.rapids.sql.cost.enabled` (default true) the planner",
+        "estimates every logical subtree's device time (per-dispatch sync",
+        "floor x sync count + bytes over the device pipeline) and host",
+        "time (bytes over the host engine) from parquet/ORC footer stats",
+        "and places whole maximal subtrees on the HOST engine when the",
+        "host estimate strictly wins — small inputs cannot amortize the",
+        "~70-100ms round-trip floor of a tunneled chip. Calibration",
+        "constants (`cost.deviceSyncFloorMs`, `cost.deviceThroughputGBps`,",
+        "`cost.hostThroughputGBps`, `cost.maxHostBytes`) are",
+        "conf-overridable; `cost.explain` renders per-node estimates;",
+        "`SRT_COST=0` restores the legacy all-device planner for a whole",
+        "process. Placement stands down in test mode, under an armed",
+        "fault schedule, on non-inprocess transports, and for plans",
+        "without a file scan.",
+        "",
+        "At runtime, `spark.rapids.sql.aqe.replan.enabled` (default true)",
+        "re-plans mid-query from OBSERVED shuffle sizes: each shuffled",
+        "hash join's build-side exchange materializes first, its",
+        "transport session records exact per-partition bytes, and a build",
+        "side within `autoBroadcastJoinThreshold` demotes the join to a",
+        "broadcast hash join — the probe side never shuffles, the fusion",
+        "pass re-runs over the rewritten subtree, and lineage recovery",
+        "still covers the re-planned stages. Post-shuffle coalescing",
+        "merges partitions while BOTH `aqe.coalescePartitions.targetRows`",
+        "and `aqe.coalescePartitions.targetBytes` hold. Decisions and",
+        "estimate-vs-actual error surface in the `Cost@query` metrics",
+        "entry and bench.py's `cost` JSON block. See docs/performance.md.",
         "",
         "## Dynamic per-rule kill switches",
         "",

@@ -31,8 +31,8 @@ from spark_rapids_tpu.exprs.strings import (      # noqa: F401
     ConcatStrings, ConcatWs, Contains, EndsWith, InitCap, Length, Like,
     Lower, RegExpExtract, RegExpReplace, StartsWith, StringLocate,
     StringLPad, StringRepeat, StringReplace, StringReverse, StringRPad,
-    StringTrim, StringTrimLeft, StringTrimRight, Substring, Translate,
-    Upper)
+    StringSplit, StringTrim, StringTrimLeft, StringTrimRight, Substring,
+    SubstringIndex, Translate, Upper)
 from spark_rapids_tpu.exprs.hash import Murmur3Hash  # noqa: F401
 from spark_rapids_tpu.exprs.nondeterministic import (  # noqa: F401
     EvalContext, InputFileName, MonotonicallyIncreasingID, Rand,

@@ -287,6 +287,133 @@ class EndsWith(_NeedleOp):
         return at_end & (lengths >= m)
 
 
+def _greedy_matches(xp, hits, m: int):
+    """Greedy left-to-right non-overlapping occurrence selection over the
+    sliding-window hits (N, W): a hit is REAL iff no real hit covers it —
+    the scan Java's indexOf loop performs, vectorized over rows. The
+    device path uses ``lax.scan`` over the width axis (constant compile
+    cost in W); the host path loops columns in numpy."""
+    n, w = hits.shape
+    if m <= 1 or w == 0:
+        return hits
+    if xp is np:
+        cols = []
+        next_free = np.zeros((n,), np.int32)
+        for j in range(w):
+            real_j = hits[:, j] & (next_free <= j)
+            next_free = np.where(real_j, j + m, next_free)
+            cols.append(real_j)
+        return np.stack(cols, axis=1)
+    import jax
+
+    def step(next_free, xs):
+        hits_j, j = xs
+        real_j = hits_j & (next_free <= j)
+        return xp.where(real_j, j + m, next_free), real_j
+
+    _, reals = jax.lax.scan(
+        step, xp.zeros((n,), jnp.int32),
+        (hits.T, xp.arange(w, dtype=jnp.int32)))
+    return reals.T
+
+
+def _delim_scan(xp, data, lengths, delim: bytes):
+    """(occ_incl, completed, total) for the greedy occurrences of
+    ``delim``: occ_incl[j] = occurrences STARTED at or before byte j,
+    completed[j] = occurrences fully before byte j, total = count."""
+    m = len(delim)
+    real = _greedy_matches(xp, _sliding_match(xp, data, lengths, delim), m)
+    occ_incl = xp.cumsum(real.astype(np.int32), axis=1)
+    n, w = data.shape
+    if w > m:
+        completed = xp.concatenate(
+            [xp.zeros((n, m), np.int32), occ_incl[:, :-m]], axis=1)
+    else:
+        completed = xp.zeros((n, w), np.int32)
+    total = occ_incl[:, -1] if w else xp.zeros((n,), np.int32)
+    return occ_incl, completed, total
+
+
+class SubstringIndex(StringUnary):
+    """substring_index(str, delim, count) — Spark/Hive semantics over a
+    LITERAL delimiter (the same restriction as GpuSubstringIndex):
+    count>0 keeps everything before the count-th occurrence, count<0
+    everything after the |count|-th occurrence from the end, count==0 is
+    empty; fewer occurrences than |count| keeps the whole string."""
+
+    def __init__(self, child: Expression, delim: str, count: int):
+        super().__init__(child)
+        if not delim:
+            raise ValueError(
+                "substring_index delimiter must be a non-empty literal")
+        self.delim = delim
+        self.count = int(count)
+
+    def kernel(self, xp, data, lengths, validity):
+        delim = self.delim.encode("utf-8")
+        occ_incl, completed, total = _delim_scan(xp, data, lengths, delim)
+        inside = byte_mask(xp, data.shape[1], lengths)
+        if self.count > 0:
+            keep = inside & (occ_incl < self.count)
+        elif self.count < 0:
+            k = -self.count
+            keep = inside & (completed >= (total - k + 1)[:, None])
+        else:
+            keep = xp.zeros_like(inside)
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, validity
+
+
+class StringSplit(Expression):
+    """split(str, delim)[index] — the element-access form of Spark's
+    StringSplit (array columns are not a device type here; the
+    ubiquitous split(...).getItem(i) pattern lowers to this). The
+    delimiter is a LITERAL matched verbatim (no regex — the reference's
+    GpuStringSplit carries the same pattern restriction); out-of-range
+    or negative indices yield NULL, and Spark's limit=-1 semantics keep
+    trailing empty elements."""
+
+    def __init__(self, child: Expression, delim: str, index: int):
+        if not delim:
+            raise ValueError("split delimiter must be a non-empty literal")
+        self.child = child
+        self.delim = delim
+        self.index = int(index)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def _kernel(self, xp, data, lengths, validity):
+        delim = self.delim.encode("utf-8")
+        occ_incl, completed, total = _delim_scan(xp, data, lengths, delim)
+        inside = byte_mask(xp, data.shape[1], lengths)
+        in_delim = (occ_incl - completed) > 0
+        if self.index < 0:
+            keep = xp.zeros_like(inside)
+            valid = xp.zeros_like(validity)
+        else:
+            keep = inside & ~in_delim & (completed == self.index)
+            valid = validity & (self.index < total + 1)
+        out, out_len = pack_left(xp, data, keep)
+        return out, out_len, valid
+
+    def eval(self, batch: DeviceBatch):
+        col = as_device_column(self.child.eval(batch), batch)
+        data, lengths, validity = self._kernel(
+            jnp, col.data, col.lengths, col.validity)
+        return make_column(dt.STRING, data, validity, lengths)
+
+    def eval_host(self, batch: HostBatch):
+        col = as_host_column(self.child.eval_host(batch), batch)
+        m, lens = _host_to_matrix(col)
+        data, lengths, validity = self._kernel(np, m, lens, col.validity)
+        return _matrix_to_host(data, lengths, validity)
+
+
 class StringLocate(Expression):
     """locate(needle, str, start=1): 1-based char position of first match at
     or after ``start``; 0 if absent (ref GpuStringLocate)."""

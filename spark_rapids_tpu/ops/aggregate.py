@@ -1217,7 +1217,20 @@ class HashAggregateExec(Exec):
     # -- host oracle ---------------------------------------------------------
     def _host_groups(self, hbs, key_evaluator, input_lists):
         """Shared host grouping: returns (order, key_values, groups) where
-        groups[key][ai] is the list of python values for aggregate ai."""
+        groups[key][ai] is the list of python values for aggregate ai.
+
+        Primitive (non-string) keys take a vectorized path — one stable
+        lexsort over canonicalized key arrays instead of a per-row python
+        dict walk. Host placement (plan/cost.py) made the host engine a
+        first-class executor, so grouping millions of rows here must run
+        at numpy speed, not interpreter speed (~50x). Semantics are
+        identical: first-seen group order, within-group row order (First/
+        Last), NaN==NaN and -0.0==0.0 canonical grouping, null keys group
+        together."""
+        fast = self._host_groups_vectorized(hbs, key_evaluator,
+                                            input_lists)
+        if fast is not None:
+            return fast
         groups = {}
         key_values = {}
         order = []
@@ -1234,6 +1247,126 @@ class HashAggregateExec(Exec):
                 for ai, vals in enumerate(inlists):
                     groups[key][ai].append(vals[i] if vals is not None
                                            else 1)
+        return order, key_values, groups
+
+    def _host_groups_vectorized(self, hbs, key_evaluator, input_lists):
+        """The numpy fast path of :meth:`_host_groups`, or None when the
+        shape doesn't qualify (string keys keep the exact python-loop
+        canonicalization)."""
+        nrows = [hb.num_rows for hb in hbs]
+        total = sum(nrows)
+        if total == 0:
+            return [], {}, {}
+        keycols0 = key_evaluator[0] if key_evaluator else []
+        if any(kc.dtype.is_string for kc in keycols0):
+            return None
+        nkeys = len(keycols0)
+        nags = len(self.aggs)
+
+        def group_lists(idx_groups):
+            out_per_agg = []
+            for ai in range(nags):
+                parts = [il[ai] for il in input_lists]
+                if any(p is None for p in parts):
+                    out_per_agg.append([[1] * len(idx)
+                                        for idx in idx_groups])
+                    continue
+                merged = parts[0] if len(parts) == 1 else \
+                    [v for p in parts for v in p]
+                arr = np.empty(len(merged), dtype=object)
+                try:
+                    arr[:] = merged          # scalars: one C-level copy
+                    ok = True
+                except (ValueError, TypeError):
+                    ok = False               # tuple rows (merge buffers)
+                if ok:
+                    out_per_agg.append([arr[idx].tolist()
+                                        for idx in idx_groups])
+                else:
+                    out_per_agg.append([[merged[i] for i in idx.tolist()]
+                                        for idx in idx_groups])
+            return out_per_agg
+
+        if nkeys == 0:
+            idx_all = np.arange(total, dtype=np.int64)
+            per_agg = group_lists([idx_all])
+            key = ()
+            return [key], {key: []}, {key: [per_agg[ai][0]
+                                            for ai in range(nags)]}
+
+        # Canonicalize each key column across batches: an exact-equality
+        # uint64/int64 view where NaNs share one bit pattern, -0.0 == 0.0
+        # and invalid rows compare equal regardless of payload.
+        views = []
+        valids = []
+        raws = []
+        for ki in range(nkeys):
+            cols = [ke[ki] for ke in key_evaluator]
+            data = np.concatenate([np.asarray(c.data) for c in cols]) \
+                if len(cols) > 1 else np.asarray(cols[0].data)
+            valid = np.concatenate([np.asarray(c.validity)
+                                    for c in cols]) \
+                if len(cols) > 1 else np.asarray(cols[0].validity)
+            dtype = cols[0].dtype
+            if dtype.is_floating:
+                d = data.astype(np.float64) + 0.0     # -0.0 -> +0.0
+                nanmask = np.isnan(d)
+                if nanmask.any():
+                    d = d.copy()
+                    d[nanmask] = np.nan               # canonical NaN bits
+                view = d.view(np.uint64).astype(np.int64, copy=False)
+            elif dtype.is_boolean:
+                view = data.astype(np.int64)
+            else:
+                view = data.astype(np.int64, copy=False)
+            view = np.where(valid, view, np.int64(0))
+            views.append(view)
+            valids.append(valid.astype(np.int8))
+            raws.append((dtype, data, valid))
+        order_idx = np.lexsort(tuple(
+            a for ki in range(nkeys - 1, -1, -1)
+            for a in (views[ki], valids[ki])))
+        new_flags = np.zeros(total, dtype=bool)
+        new_flags[0] = True
+        for ki in range(nkeys):
+            sv = views[ki][order_idx]
+            sa = valids[ki][order_idx]
+            new_flags[1:] |= (sv[1:] != sv[:-1]) | (sa[1:] != sa[:-1])
+        starts = np.flatnonzero(new_flags)
+        ends = np.append(starts[1:], total)
+        # First-seen emission order: lexsort is stable, so order_idx at a
+        # group's start IS its first original row.
+        emit = np.argsort(order_idx[starts], kind="stable")
+        # Within a group, order_idx is already ascending (stable sort
+        # keeps equal keys in original row order — First/Last depend on
+        # it).
+        idx_groups = [order_idx[starts[g]:ends[g]] for g in emit]
+        per_agg = group_lists(idx_groups)
+        order = []
+        key_values = {}
+        groups = {}
+        for gi, g in enumerate(emit):
+            rep = int(order_idx[starts[g]])
+            key = []
+            vals = []
+            for ki in range(nkeys):
+                v_ok = bool(valids[ki][rep])
+                key.append((v_ok, int(views[ki][rep])))
+                if not v_ok:
+                    vals.append(None)
+                    continue
+                dtype, data, _ = raws[ki]
+                if dtype.is_floating:
+                    f = float(data[rep])
+                    vals.append(0.0 if f == 0.0 else f)
+                elif dtype.is_boolean:
+                    vals.append(bool(data[rep]))
+                else:
+                    vals.append(int(data[rep]))
+            key = tuple(key)
+            order.append(key)
+            key_values[key] = vals
+            groups[key] = [per_agg[ai][gi] for ai in range(nags)]
         return order, key_values, groups
 
     def execute_host(self, ctx, partition):

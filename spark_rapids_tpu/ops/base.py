@@ -427,6 +427,14 @@ class Exec:
                 faults.set_recovery_sink(self._recovery_metrics(ctx))
                 try:
                     from spark_rapids_tpu.parallel import pipeline as PL
+                    from spark_rapids_tpu.parallel import replan as RP
+                    # Runtime adaptive re-planning BEFORE stage
+                    # prematerialization: build-side exchanges
+                    # materialize now, observed sizes demote shuffled
+                    # joins to broadcast, and the skipped probe
+                    # exchanges are flagged so the stage pass does not
+                    # shuffle them anyway (parallel/replan.py).
+                    RP.plan_adaptive(ctx, self)
                     # Independent stages (join build/probe sides...)
                     # materialize their exchange outputs concurrently
                     # before the ordered partition loop; a no-op when

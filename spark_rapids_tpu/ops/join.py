@@ -678,7 +678,19 @@ class ShuffledHashJoinExec(Exec, _JoinKernelMixin):
                             self.children[1].schema, self.join_type)
 
     def num_partitions(self, ctx) -> int:
+        delegate = self._replan_delegate(ctx)
+        if delegate is not None:
+            return delegate.num_partitions(ctx)
         return self.children[0].num_partitions(ctx)
+
+    def _replan_delegate(self, ctx) -> Optional[Exec]:
+        """The broadcast delegate a runtime re-plan swapped in for this
+        query (parallel/replan.py), or None. Decisions are per-context:
+        the cached physical plan and the host oracle never see them.
+        BroadcastHashJoinExec overrides every consulting method, so a
+        delegate can never consult itself."""
+        from spark_rapids_tpu.parallel import replan as RP
+        return RP.demoted(ctx, self)
 
     def _key_ordinals(self, side: Exec, keys) -> List[int]:
         # Keys must be bound references for the kernel; project otherwise.
@@ -691,6 +703,13 @@ class ShuffledHashJoinExec(Exec, _JoinKernelMixin):
         return ords
 
     def execute_device(self, ctx, partition):
+        delegate = self._replan_delegate(ctx)
+        if delegate is not None:
+            # Runtime demotion: stream the rewritten broadcast subtree —
+            # the build side serves the already-materialized exchange,
+            # the probe side reads its child UNSHUFFLED.
+            yield from delegate.execute_device(ctx, partition)
+            return
         # 'right' join probes with the right side preserved: build LEFT.
         build_right = self.join_type != "right"
         build_child = self.children[1] if build_right else self.children[0]
@@ -950,8 +969,11 @@ def _empty_like(schema: Schema) -> DeviceBatch:
 
 
 def _host_join(op, ctx, partition, nested_loop: bool = False):
-    """Host oracle: nested-loop evaluation with SQL equi-join null
-    semantics. O(n*m) — fine for tests."""
+    """Host join with SQL equi-join null semantics. Equi-joins probe a
+    dict index over the build side (O(n+m) — the host engine is a
+    first-class placement target now, plan/cost.py, so this path must
+    not be quadratic); nested-loop joins keep the O(n*m) scan their
+    arbitrary conditions require."""
     def _collect(child):
         out = []
         for cp in range(child.num_partitions(ctx)):
@@ -1019,18 +1041,27 @@ def _host_join(op, ctx, partition, nested_loop: bool = False):
         c = as_host_column(cond.eval_host(hb), hb)
         return bool(c.validity[0]) and bool(c.data[0])
 
+    # Equi-join: index build-side rows by canonicalized key so each
+    # probe row visits only its key group (ascending ri, preserving the
+    # nested loop's emission order exactly).
+    index = None
+    if not nested_loop:
+        index = {}
+        for ri, rrow in enumerate(right_rows):
+            if keys_ok(rrow, rkeys):
+                index.setdefault(key_of(rrow, rkeys), []).append(ri)
+
     out = []
     matched_right = [False] * len(right_rows)
     for lrow in left_rows:
         matches = []
-        if nested_loop or keys_ok(lrow, lkeys):
+        if nested_loop:
             for ri, rrow in enumerate(right_rows):
-                if not nested_loop:
-                    if not keys_ok(rrow, rkeys):
-                        continue
-                    if key_of(lrow, lkeys) != key_of(rrow, rkeys):
-                        continue
                 if cond_ok(lrow, rrow):
+                    matches.append(ri)
+        elif keys_ok(lrow, lkeys):
+            for ri in index.get(key_of(lrow, lkeys), ()):
+                if cond_ok(lrow, right_rows[ri]):
                     matches.append(ri)
         if jt in ("inner", "cross"):
             for ri in matches:

@@ -85,19 +85,29 @@ class ShuffleExchangeExec(Exec):
         gkey = f"shuffle-groups:{id(self):x}"
         groups = ctx.cache.get(gkey)
         if groups is None:
-            self._materialize_device(ctx)
+            sess = self._materialize_device(ctx)
             sizes = ctx.cache.get(self._cache_key(True) + ":rows",
                                   [0] * n)
             target = int(ctx.conf.get(C.AQE_COALESCE_TARGET_ROWS))
+            # Byte-aware merging from the OBSERVED shard bytes the
+            # transport session recorded at materialization: partitions
+            # merge while BOTH the row and the byte target hold, so a
+            # few fat skewed buckets never collapse into one oversized
+            # reduce partition just because their row counts are low.
+            tbytes = int(ctx.conf.get(C.AQE_COALESCE_TARGET_BYTES))
             groups = []
             cur: List[int] = []
             cur_rows = 0
+            cur_bytes = 0
             for b in range(n):
-                if cur and cur_rows + sizes[b] > target:
+                b_bytes = sess.observed_bytes(b)
+                if cur and (cur_rows + sizes[b] > target or
+                            cur_bytes + b_bytes > tbytes):
                     groups.append(cur)
-                    cur, cur_rows = [], 0
+                    cur, cur_rows, cur_bytes = [], 0, 0
                 cur.append(b)
                 cur_rows += sizes[b]
+                cur_bytes += b_bytes
             if cur:
                 groups.append(cur)
             m = ctx.metrics_for(self)
@@ -471,11 +481,24 @@ class ShuffleExchangeExec(Exec):
         buckets = self._materialize_host(ctx)
         yield from iter(buckets[partition])
 
+    # -- runtime adaptive re-planning ----------------------------------------
+    def observed_total_bytes(self, ctx) -> int:
+        """Materialize (idempotent) and return the EXACT total bytes the
+        transport session observed across all map shards — the number
+        runtime re-planning (parallel/replan.py) demotes joins on."""
+        sess = self._materialize_device(ctx)
+        return sess.observed_bytes()
+
     # -- pipelined execution -------------------------------------------------
     def stage_prematerialize(self, ctx) -> None:
         """Materialize this stage's durable output now (idempotent vs
         the context cache) — the hook parallel/pipeline.py uses to run
-        independent sibling stages concurrently."""
+        independent sibling stages concurrently. A runtime re-plan that
+        demoted this exchange's join to a broadcast skips the probe-side
+        materialization entirely (parallel/replan.py flags it): shuffling
+        a side the demoted join will stream unshuffled is pure waste."""
+        if ctx.cache.get(f"replan-skip:{id(self):x}"):
+            return
         if ctx.cache.get("engine") == "device":
             self._materialize_device(ctx)
 

@@ -223,10 +223,20 @@ def split_batch(batch: DeviceBatch, pids: jnp.ndarray,
 
 def split_host_batch(hb: HostBatch, pids: np.ndarray,
                      num_partitions: int) -> List[HostBatch]:
+    """ONE stable argsort + one gather per column, then zero-copy slices
+    per destination — instead of a boolean-mask scan of the whole batch
+    per partition (O(n) x num_partitions). The host engine is a
+    first-class placement target now (plan/cost.py), so its shuffle
+    split runs the same move-all-rows-once shape as the device split."""
+    order = np.argsort(pids, kind="stable")
+    counts = np.bincount(pids[order], minlength=num_partitions)
+    offsets = np.concatenate([[0], np.cumsum(counts)])
+    gathered = [(c.dtype, c.data[order], c.validity[order])
+                for c in hb.columns]
     out = []
     for p in range(num_partitions):
-        keep = pids == p
-        cols = [HostColumn(c.dtype, c.data[keep], c.validity[keep])
-                for c in hb.columns]
+        lo, hi = offsets[p], offsets[p + 1]
+        cols = [HostColumn(dtype, data[lo:hi], validity[lo:hi])
+                for dtype, data, validity in gathered]
         out.append(HostBatch(hb.names, cols))
     return out

@@ -38,7 +38,7 @@ decoding into silently wrong rows.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 
 class ShardLostError(RuntimeError):
@@ -71,6 +71,25 @@ class ShuffleSession:
         # loss/corruption error must carry.
         self.tag = tag
         self.owner = owner
+        # Observed per-partition byte sizes (the size-observation hook
+        # runtime adaptive re-planning and byte-aware partition
+        # coalescing read, parallel/replan.py / exchange._groups): every
+        # implementation records what it actually wrote, in its own
+        # units (device bytes inprocess/mesh, framed blob bytes
+        # hostfile) — EXACT sizes, the GpuCustomShuffleReaderExec
+        # materialized-stats analog.
+        self.shard_bytes: Dict[int, int] = {}
+
+    def record_shard_bytes(self, partition: int, nbytes: int) -> None:
+        self.shard_bytes[partition] = \
+            self.shard_bytes.get(partition, 0) + int(nbytes)
+
+    def observed_bytes(self, partition: Optional[int] = None) -> int:
+        """Total observed bytes of one partition, or of the whole map
+        output (partition=None). Only meaningful after commit()."""
+        if partition is not None:
+            return self.shard_bytes.get(partition, 0)
+        return sum(self.shard_bytes.values())
 
     # -- map side ------------------------------------------------------------
     def write_shard(self, partition: int, batch) -> None:

@@ -148,6 +148,7 @@ class HostFileSession(ShuffleSession):
             f.write(blob)
         os.replace(tmp, path)
         rows = batch.rows_hint
+        self.record_shard_bytes(partition, len(blob))
         self._written.setdefault(partition, []).append(
             {"file": f"{self.worker}/{fname}",
              "capacity": int(batch.capacity),

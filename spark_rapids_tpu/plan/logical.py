@@ -256,6 +256,21 @@ def translate(c, src: str, to: str) -> Column:
     return Column(("translate", _as_col(c), src, to))
 
 
+def split(c, delim: str, index: int) -> Column:
+    """split(str, delim)[index]: the ``index``-th (0-based) element of
+    the literal-delimiter split — Spark's split(...).getItem(i) pattern
+    (array columns are not a device type; the element access IS the
+    expression). Out-of-range indices are NULL; trailing empty elements
+    are kept (limit=-1 semantics)."""
+    return Column(("split", _as_col(c), delim, int(index)))
+
+
+def substring_index(c, delim: str, count: int) -> Column:
+    """substring_index(str, delim, count) with Spark/Hive semantics over
+    a literal delimiter."""
+    return Column(("substring_index", _as_col(c), delim, int(count)))
+
+
 def repeat(c, n: int) -> Column:
     return Column(("repeat", _as_col(c), n))
 
@@ -702,6 +717,10 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.RegExpExtract(rec(node[1]), node[2], node[3])
     if kind == "translate":
         return E.Translate(rec(node[1]), node[2], node[3])
+    if kind == "split":
+        return E.StringSplit(rec(node[1]), node[2], node[3])
+    if kind == "substring_index":
+        return E.SubstringIndex(rec(node[1]), node[2], node[3])
     if kind == "repeat":
         return E.StringRepeat(rec(node[1]), node[2])
     if kind == "reverse":

@@ -220,10 +220,15 @@ class NodeMeta:
     children: List["NodeMeta"]
     reasons: List[str] = dataclasses.field(default_factory=list)
     notes: List[str] = dataclasses.field(default_factory=list)
+    # Cost-based placement (plan/cost.py): True flips this node to the
+    # host engine as a PLACEMENT choice, not a capability fallback —
+    # kept separate from ``reasons`` so explain reasons and test-mode
+    # allowlists keep their capability meaning.
+    cost_host: bool = False
 
     @property
     def on_device(self) -> bool:
-        return not self.reasons
+        return not self.reasons and not self.cost_host
 
     def explain_lines(self, depth: int = 0, not_on_device_only=False):
         mark = "*" if self.on_device else "!"
@@ -425,6 +430,10 @@ class PhysicalPlan:
             for i, f in enumerate(fused):
                 members = ", ".join(type(o).__name__ for o in f.ops)
                 lines.append(f"  *Stage #{i} <{f.name}> fuses [{members}]")
+        report = getattr(self, "cost_report", None)
+        if report is not None and (report.placements or report.lines or
+                                   bool(self.conf.get(C.COST_EXPLAIN))):
+            lines.extend(report.explain_lines())
         return "\n".join(lines)
 
     def collect(self, ctx=None, timeout_ms=None, cancel_event=None):
@@ -455,6 +464,17 @@ class PhysicalPlan:
             sched = SC.metrics_entry(ctx)
             sched.add("admitted", 1)
             sched.add("queuedMs", ticket.queued_ms)
+        # Cost@query audit trail: static placement decisions land here at
+        # admission; runtime re-planning (parallel/replan.py) adds its
+        # demotion counters to the same entry during execution.
+        report = getattr(self, "cost_report", None)
+        if report is not None and report.skipped is None:
+            cm = ctx.metrics.setdefault("Cost@query", Metrics(owner="Cost"))
+            cm.add("placements", report.placements)
+            cm.add("hostPlacedNodes", report.nodes_host_placed)
+            cm.add("estDeviceMs", report.est_device_ms)
+            cm.add("estHostMs", report.est_host_ms)
+            cm.add("estSyncs", report.est_syncs)
         # Arm the fault schedule ONCE per query (not per attempt: a
         # retried attempt must run against the REMAINING schedule, or a
         # count-based transient fault re-fires forever), and clear any
@@ -600,6 +620,12 @@ class Planner:
         logical = pushdown_filters(prune_columns(merge_windows(logical)))
         self._force_perfile = _uses_input_file(logical)
         meta = wrap_and_tag(logical, self.conf)
+        # Cost-based placement (plan/cost.py): flip whole maximal
+        # subtrees to the host engine when the footer-stats estimate
+        # says the sync floor can't amortize. Runs after tagging so
+        # capability fallbacks already shaped ``on_device``.
+        from spark_rapids_tpu.plan import cost as COST
+        cost_report = COST.apply_placement(meta, self.conf)
         if self.conf.explain in ("ALL", "NOT_ON_GPU"):
             print("\n".join(meta.explain_lines(
                 not_on_device_only=self.conf.explain == "NOT_ON_GPU")))
@@ -622,6 +648,7 @@ class Planner:
             root, num_fused = fuse_stages(root, side)
         phys = PhysicalPlan(root, side, meta, self.conf)
         phys.num_fused_stages = num_fused
+        phys.cost_report = cost_report
         if self.conf.test_enabled:
             allowed = {s for s in str(self.conf.get(
                 C.TEST_ALLOWED_NONTPU)).split(",") if s}
@@ -1086,6 +1113,7 @@ class Planner:
             return BroadcastNestedLoopJoinExec(
                 lch, rch, plan.join_type, cond), want_dev
         strategy = plan.strategy
+        est = None
         if strategy == "auto":
             # Stats-driven choice (autoBroadcastJoinThreshold): broadcast
             # when the build side's estimated bytes fit the threshold,
@@ -1113,5 +1141,9 @@ class Planner:
         n = self._shuffle_partitions()
         lex = self._hash_exchange(lch, lkeys, n)
         rex = self._hash_exchange(rch, rkeys, n)
-        return ShuffledHashJoinExec(
-            lex, rex, lkeys, rkeys, plan.join_type, cond), want_dev
+        shj = ShuffledHashJoinExec(
+            lex, rex, lkeys, rkeys, plan.join_type, cond)
+        # Planning-time build estimate, kept for runtime re-planning's
+        # estimate-vs-actual error metric (parallel/replan.py).
+        shj.est_build_bytes = est
+        return shj, want_dev

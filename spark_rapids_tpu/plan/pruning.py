@@ -113,23 +113,36 @@ def estimate_bytes(plan: LogicalPlan) -> Optional[int]:
     size, matching Spark's non-CBO stats). None = unknown (never
     broadcast on unknown)."""
     if isinstance(plan, L.FileScan):
-        if plan.fmt != "parquet":
-            return None
-        from spark_rapids_tpu.io.scan import _parquet_metadata
-        names = {n for n, _ in plan.source_schema}
-        total = 0
-        try:
-            for path in plan.paths:
-                md = _parquet_metadata(path)
-                for rg in range(md.num_row_groups):
-                    g = md.row_group(rg)
-                    for ci in range(g.num_columns):
-                        c = g.column(ci)
-                        if c.path_in_schema.split(".")[0] in names:
-                            total += c.total_uncompressed_size
-        except OSError:
-            return None
-        return total
+        if plan.fmt == "parquet":
+            from spark_rapids_tpu.io.scan import _parquet_metadata
+            names = {n for n, _ in plan.source_schema}
+            total = 0
+            try:
+                for path in plan.paths:
+                    md = _parquet_metadata(path)
+                    for rg in range(md.num_row_groups):
+                        g = md.row_group(rg)
+                        for ci in range(g.num_columns):
+                            c = g.column(ci)
+                            if c.path_in_schema.split(".")[0] in names:
+                                total += c.total_uncompressed_size
+            except OSError:
+                return None
+            return total
+        if plan.fmt in ("orc", "csv"):
+            # ORC footers don't expose per-column uncompressed sizes the
+            # way parquet row groups do; approximate from file sizes
+            # (x3 for ORC's typical compression, x1 for text CSV).
+            # Coarse, but enough to steer placement (plan/cost.py) the
+            # same way the parquet path does.
+            import os as _os
+            try:
+                raw = sum(_os.path.getsize(p) for p in plan.paths)
+            except OSError:
+                return None
+            factor = 3 if plan.fmt == "orc" else 1
+            return raw * factor
+        return None
     if isinstance(plan, L.InMemoryScan):
         total = 0
         for part in plan.partitions:

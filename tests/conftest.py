@@ -27,6 +27,17 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Cost-based placement stands down for the suite: the tests emulate the
+# TPU on local CPU devices, where the ~80ms tunnel sync floor the model
+# is calibrated for does not exist — left on, the production constants
+# would (correctly, for real hardware) host-place nearly every
+# mini-scale fixture and the suite would stop exercising the device
+# engine it exists to cover. Placement behavior itself is covered by
+# tests/test_cost.py, whose sessions opt in via the conf key (conf
+# beats env in cost_enabled). An explicit SRT_COST in the environment
+# (e.g. the CI no-cost-placement matrix entry) still wins.
+os.environ.setdefault("SRT_COST", "0")
+
 # Acceptance hook: SRT_STAGE_FUSION=0 flips the stage-fusion default off
 # for a whole test run, verifying every suite still passes with the
 # unfused plan shape (spark.rapids.sql.stageFusion.enabled=false).
@@ -47,6 +58,44 @@ if os.environ.get("SRT_PIPELINE_PREFETCH"):
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def _map_count():
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:       # non-Linux: no map table, no ceiling to dodge
+        return 0
+
+
+@pytest.fixture(autouse=True)
+def _jit_map_pressure_relief():
+    """Shed compiled executables before the kernel's mmap ceiling.
+
+    A live XLA CPU executable for a real query kernel holds ~80 mmap
+    regions and jax keeps every compiled program of the process alive,
+    so a full single-process suite run accumulates memory maps
+    monotonically; once the process crosses the kernel's
+    vm.max_map_count ceiling (65530 by default) the next compile's mmap
+    fails and XLA SIGSEGVs — the run dies at whatever test happens to
+    compile there. Relief is tiered: first evict the OLDEST half of the
+    engine's kernel cache (cold one-off kernels from earlier files; the
+    current file's hot set survives, so there is no recompile storm),
+    and only if the map table is still critical drop every jax cache
+    (kernels recompile transparently — slow, but alive)."""
+    yield
+    import gc
+    if _map_count() > 52000:
+        from spark_rapids_tpu.ops import kernel_cache as kc
+        cache = kc.cache()
+        bound = cache.max_entries
+        cache.configure(max(bound // 2, 64))
+        cache.configure(bound)
+        gc.collect()
+        if _map_count() > 61000:
+            import jax
+            jax.clear_caches()
+            gc.collect()
 
 
 @pytest.fixture(autouse=True)

@@ -314,6 +314,9 @@ class TestIO:
                                                     tmp_path):
         from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
         from spark_rapids_tpu.ops.base import ExecContext
+        # Asserts DEVICE scan-cache hits; the cost model would
+        # (correctly) host-place this tiny scan.
+        session.set("spark.rapids.sql.cost.enabled", False)
         DEVICE_SCAN_CACHE.clear()
         df = session.create_dataframe(DATA, SCHEMA, num_partitions=2)
         out = str(tmp_path / "tc")
@@ -425,6 +428,9 @@ class TestOrcPushdown:
         paorc.write_table(pa.table(
             {"x": np.arange(5000, 6000, dtype=np.int64)}), p2)
         s = TpuSession()
+        # Asserts the DEVICE scan's stripe-pruning counters; the cost
+        # model would (correctly) host-place this tiny ORC scan.
+        s.set("spark.rapids.sql.cost.enabled", False)
         df = s.read.orc(p1, p2).filter(col("x") >= 5500)
         got = sorted(r[0] for r in df.collect())
         assert got == list(range(5500, 6000))

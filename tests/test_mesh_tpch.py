@@ -38,6 +38,10 @@ def _session(transport: str, spool: str = "") -> TpuSession:
     s.set("spark.rapids.sql.shuffle.transport", transport)
     s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
     s.set("spark.rapids.sql.hasNans", False)
+    # Transport parity needs the DEVICE exchange paths; the cost model
+    # would host-place these mini-scale queries (correctly) and bypass
+    # the transports under test.
+    s.set("spark.rapids.sql.cost.enabled", False)
     if spool:
         s.set("spark.rapids.sql.shuffle.transport.hostfile.dir", spool)
     # Shuffle joins force exchanges on both sides so the transport under

@@ -1,0 +1,192 @@
+"""Runtime adaptive re-planning (ISSUE 7 tentpole, parallel/replan.py).
+
+A shuffled hash join whose build side MATERIALIZES small — the planner's
+footer estimate said big (filters keep their child's size, Spark's
+non-CBO stats), the observed shuffle said tiny — demotes to a broadcast
+hash join mid-query: the probe side never shuffles, results match the
+oracle, and lineage-scoped recovery still covers the re-planned stages.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as papq
+import pytest
+
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks.compare import compare_results
+from spark_rapids_tpu.ops.join import (
+    BroadcastHashJoinExec, ShuffledHashJoinExec)
+from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+from spark_rapids_tpu.plan.logical import agg_sum, col
+
+
+@pytest.fixture(scope="module")
+def pq_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("replan_pq")
+    rng = np.random.default_rng(5)
+    # Probe: 60k rows (big enough to exercise multi-partition shuffles,
+    # small enough to keep XLA join-kernel compiles light under the full
+    # suite). Build: a dim whose FILTERED size is tiny but whose footer
+    # estimate (filter keeps child size) stays above the threshold.
+    papq.write_table(pa.table({
+        "k": rng.integers(0, 500, 60_000, dtype=np.int64),
+        "v": rng.uniform(0, 1, 60_000),
+    }), os.path.join(d, "big.parquet"))
+    papq.write_table(pa.table({
+        "dk": np.arange(2000, dtype=np.int64),
+        "w": rng.uniform(0, 1, 2000),
+        "flag": rng.integers(0, 100, 2000, dtype=np.int64),
+    }), os.path.join(d, "dim.parquet"))
+    return str(d)
+
+
+def _session(**conf):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    # Placement off: these tests pin the DEVICE plan to exercise the
+    # runtime-replan layer in isolation.
+    s.set("spark.rapids.sql.cost.enabled", False)
+    # Estimates exceed this, observed (filtered) bytes do not — the
+    # static planner keeps the shuffled join, the runtime demotes it.
+    s.set("spark.rapids.sql.autoBroadcastJoinThreshold", 20_000)
+    for k, v in conf.items():
+        s.set(k, v)
+    return s
+
+
+def _skew_join(session, pq_dir):
+    big = session.read.parquet(os.path.join(pq_dir, "big.parquet"))
+    dim = session.read.parquet(os.path.join(pq_dir, "dim.parquet")) \
+        .filter(col("flag") == 3)
+    return big.join_on(dim, ["k"], ["dk"]) \
+        .group_by("k").agg(agg_sum(col("w")).alias("sw"))
+
+
+def _find(root, cls):
+    out = []
+
+    def walk(n):
+        if isinstance(n, cls):
+            out.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return out
+
+
+class TestRuntimeDemotion:
+    def test_statically_planned_as_shuffle(self, pq_dir):
+        phys = _skew_join(_session(), pq_dir)._physical()
+        shj = [j for j in _find(phys.root, ShuffledHashJoinExec)
+               if type(j) is ShuffledHashJoinExec]
+        assert shj, "estimate must keep the shuffled join statically"
+
+    def test_demotes_and_matches_oracle(self, pq_dir):
+        df = _skew_join(_session(), pq_dir)
+        got = df.collect()
+        m = df.metrics()["Cost@query"]
+        assert m["joinDemotions"] == 1
+        assert m["replanChecks"] == 1
+        assert m["replanObservedBytes"] > 0
+        want = df.collect_host()
+        assert compare_results(got, want, sort=True)
+
+    def test_probe_shuffle_skipped(self, pq_dir):
+        df = _skew_join(_session(), pq_dir)
+        phys = df._physical()
+        phys.collect()
+        ctx = phys.last_ctx
+        join = [j for j in _find(phys.root, ShuffledHashJoinExec)
+                if type(j) is ShuffledHashJoinExec][0]
+        build_ex = join.children[1]
+        probe_ex = join.children[0]
+        # The build exchange materialized; the probe exchange never did.
+        assert f"shuffle:{id(build_ex):x}:dev" in ctx.cache
+        assert f"shuffle:{id(probe_ex):x}:dev" not in ctx.cache
+        assert ctx.cache.get(f"replan-skip:{id(probe_ex):x}")
+        # The delegate is a broadcast join over the materialized build.
+        from spark_rapids_tpu.parallel import replan as RP
+        delegate = RP.demoted(ctx, join)
+        assert isinstance(delegate, BroadcastHashJoinExec)
+
+    def test_disabled_by_conf(self, pq_dir):
+        df = _skew_join(_session(**{
+            "spark.rapids.sql.aqe.replan.enabled": False}), pq_dir)
+        got = df.collect()
+        assert "joinDemotions" not in df.metrics().get("Cost@query", {})
+        ref = _skew_join(_session(), pq_dir).collect()
+        assert compare_results(got, ref, sort=True)
+
+    def test_threshold_minus_one_disables(self, pq_dir):
+        df = _skew_join(_session(**{
+            "spark.rapids.sql.autoBroadcastJoinThreshold": -1}), pq_dir)
+        df.collect()
+        assert "joinDemotions" not in df.metrics().get("Cost@query", {})
+
+    def test_observed_above_threshold_keeps_shuffle(self, pq_dir):
+        df = _skew_join(_session(**{
+            "spark.rapids.sql.autoBroadcastJoinThreshold": 64}), pq_dir)
+        got = df.collect()
+        m = df.metrics()["Cost@query"]
+        assert m["replanChecks"] == 1
+        assert "joinDemotions" not in m
+        want = df.collect_host()
+        assert compare_results(got, want, sort=True)
+
+
+class TestByteAwareCoalesce:
+    def test_byte_target_limits_merging(self):
+        """AQE coalescing merges by observed bytes as well as rows: a
+        one-byte target keeps every reduce partition separate even when
+        the row target would merge them all."""
+        import spark_rapids_tpu as srt
+        from spark_rapids_tpu.ops.base import ExecContext
+        from spark_rapids_tpu.plan.logical import agg_count
+
+        def agg_df(session):
+            df = session.create_dataframe(
+                {"k": list(range(100)) * 4, "v": list(range(400))},
+                [("k", srt.INT64), ("v", srt.INT64)], num_partitions=4)
+            return df.group_by("k").agg(agg_count().alias("n"))
+
+        s1 = TpuSession()
+        phys = agg_df(s1)._physical()
+        ctx = ExecContext(phys.conf)
+        ctx.cache["engine"] = "device"
+        phys.root.collect(ctx, device=True)
+        coalescable = [e for e in _find(phys.root, ShuffleExchangeExec)
+                       if e.allow_coalesce]
+        assert any(e.num_partitions(ctx) < e.partitioning.num_partitions
+                   for e in coalescable)
+        ctx.close()
+
+        s2 = TpuSession()
+        s2.set("spark.rapids.sql.aqe.coalescePartitions.targetBytes", 1)
+        phys2 = agg_df(s2)._physical()
+        ctx2 = ExecContext(phys2.conf)
+        ctx2.cache["engine"] = "device"
+        phys2.root.collect(ctx2, device=True)
+        for e in _find(phys2.root, ShuffleExchangeExec):
+            assert e.num_partitions(ctx2) == e.partitioning.num_partitions
+        ctx2.close()
+
+
+class TestReplanChaos:
+    """ISSUE 7 satellite: faults injected during/after a runtime
+    re-plan — the demoted plan's stages still recover lineage-scoped."""
+
+    def test_lost_build_output_recomputes_one_stage(self, pq_dir):
+        want = _skew_join(_session(), pq_dir).collect()
+        df = _skew_join(_session(**{
+            "spark.rapids.sql.test.faults": "lostoutput@exchange.serve:1",
+            "spark.rapids.sql.test.faults.seed": 7,
+            "spark.rapids.sql.retry.backoffMs": 1,
+        }), pq_dir)
+        got = df.collect()
+        assert compare_results(got, want, sort=True)
+        m = df.metrics()
+        assert m["Recovery@query"]["stageRecomputes"] == 1
+        assert m["Cost@query"]["joinDemotions"] >= 1

@@ -33,6 +33,9 @@ def _session():
     s = TpuSession()
     s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
     s.set("spark.rapids.sql.hasNans", False)
+    # These tests assert the ingested plan lands ON the device; the cost
+    # model would (correctly) host-place the mini-scale fixtures.
+    s.set("spark.rapids.sql.cost.enabled", False)
     return s
 
 

@@ -136,6 +136,9 @@ class TestRetraceRegression:
     def _session(self):
         s = TpuSession()
         s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        # Retrace regression counts DEVICE kernel compiles; the cost
+        # model would host-place these mini-scale queries.
+        s.set("spark.rapids.sql.cost.enabled", False)
         return s
 
     @pytest.mark.parametrize("qname", ["q6", "q1"])
@@ -196,4 +199,5 @@ class TestObservability:
         # Restore the default for the rest of the suite.
         s2 = TpuSession()
         _chain_df(s2)._physical()
-        assert kc.cache().max_entries == 1024
+        from spark_rapids_tpu import config as C
+        assert kc.cache().max_entries == C.KERNEL_CACHE_MAX_ENTRIES.default

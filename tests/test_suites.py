@@ -19,6 +19,10 @@ def test_suite_query_matches_pandas(qn, data_dir):
     s = TpuSession()
     s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
     s.set("spark.rapids.sql.hasNans", False)
+    # Device-vs-pandas parity: pin the device plan (the cost model would
+    # host-place these mini-scale inputs, testing the oracle against
+    # itself).
+    s.set("spark.rapids.sql.cost.enabled", False)
     got = suites.QUERIES[qn](s, data_dir).collect()
     want = suites.pandas_query(qn, data_dir)
     assert suites.check_result(qn, got, want), (
