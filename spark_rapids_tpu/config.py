@@ -344,6 +344,54 @@ SCAN_CACHE_BYTES = conf(
     "transparent read cache in front of cold storage). 0 disables."
 ).long(4 * 1024 * 1024 * 1024)
 
+WIRE_CODEC = conf("spark.rapids.sql.wire.codec").doc(
+    "Host->device wire codec (columnar/wire.py): 'v2' (default — "
+    "dictionary, narrow-int, RLE, delta and frame-of-reference "
+    "encodings chosen per column by smallest wire size from one host "
+    "stats pass), 'v1' (dictionary + narrow-int only, the pre-fast-path "
+    "behavior), or 'plain' (logical dtypes ship untransformed — the "
+    "transport-transparency baseline; every codec is lossless, so all "
+    "three produce bit-identical query results). The SRT_WIRE_CODEC "
+    "env seeds the process default; the conf key overrides it. "
+    "Process-global, like the kernel cache.").string("v2")
+
+WIRE_MIN_UPLOAD_BYTES = conf("spark.rapids.sql.wire.minUploadBytes").doc(
+    "Upload transfer coalescing threshold: consecutive encoded scan "
+    "batches whose packed staging buffers are each below this many "
+    "bytes share ONE device_put transfer (each member still decodes "
+    "through its own cached kernel off an on-device slice, so results "
+    "are bit-identical — only the transfer count changes). Every "
+    "transfer on a tunneled link costs a fixed ~100ms floor, so many "
+    "tiny row groups used to pay it N times. 0 disables grouping."
+).long(1 << 20)
+
+JOIN_GRACE_ENABLED = conf("spark.rapids.sql.join.grace.enabled").doc(
+    "Out-of-core grace hash joins (ops/join.py): when a shuffled hash "
+    "join's build side exceeds join.grace.buildFraction of the device "
+    "budget, partition BOTH sides by key fingerprint (the same "
+    "murmur3 hash partitioning the exchange uses) into spillable "
+    "buckets and join the co-partitioned bucket pairs — so a build "
+    "side far past the device budget still runs ON DEVICE instead of "
+    "OOM-laddering to the host engine. Also registered as the OOM "
+    "escalation rung directly ABOVE host fallback: a hash join whose "
+    "single-batch build exhausts the spill/shrink ladder retries "
+    "grace-partitioned before degrading to host. This beats the "
+    "reference's RequireSingleBatch build-side restriction "
+    "(GpuShuffledHashJoinExec).").boolean(True)
+
+JOIN_GRACE_BUILD_FRACTION = conf(
+    "spark.rapids.sql.join.grace.buildFraction").doc(
+    "Fraction of the device budget a hash-join build side may occupy "
+    "as a single coalesced batch before the grace path engages; it is "
+    "also the per-bucket byte budget the grace partitioner targets."
+).double(0.5)
+
+JOIN_GRACE_MAX_PARTITIONS = conf(
+    "spark.rapids.sql.join.grace.maxPartitions").doc(
+    "Upper bound on grace-join fingerprint buckets per partition "
+    "(graceJoinPartitions counts the buckets actually used)."
+).integer(64)
+
 SHUFFLE_PARTITIONS = conf("spark.rapids.sql.shuffle.partitions").doc(
     "Number of shuffle output partitions for exchanges (analog of "
     "spark.sql.shuffle.partitions).").integer(8)
@@ -767,11 +815,55 @@ def generate_docs() -> str:
         "`overlapRatio`). See docs/performance.md for the overlap model",
         "and the interaction with the watchdog/recovery demotion ladder.",
         "",
+        "## Ingest fast path: wire codec v2 & coalesced uploads",
+        "",
+        "`spark.rapids.sql.wire.codec` (default `v2`) selects the",
+        "host->device wire codec (columnar/wire.py): per column, one",
+        "cheap host stats pass picks the smallest LOSSLESS encoding",
+        "among narrow-int / dictionary (v1's set), run-length (sorted or",
+        "low-run-count columns), delta (monotone/smooth integers: int64",
+        "base + narrow deltas, decoded by an exact jitted cumsum) and",
+        "frame-of-reference (clustered ids: base + narrow unsigned",
+        "offsets). Decodes are gathers, bitcasts and exact integer",
+        "arithmetic only — never emulated-f64 math — so every mode is",
+        "transport-transparent: `plain`, `v1` and `v2` produce",
+        "bit-identical query results (the dual-engine parity suite and",
+        "the SRT_WIRE_CODEC=plain CI matrix entry pin this).",
+        "",
+        "All of a batch's wire arrays pack into ONE contiguous",
+        "8-byte-aligned staging buffer with a static offset table, so an",
+        "upload is a single device_put transfer plus one jitted",
+        "unpack-and-decode program; consecutive encoded batches below",
+        "`spark.rapids.sql.wire.minUploadBytes` share a transfer. The",
+        "pack half runs on pipeline prefetch threads, so the ordered",
+        "consumer only dispatches. bench.py's `wire` JSON block reports",
+        "raw vs encoded bytes, per-codec column counts, transfer counts",
+        "and the staging hit rate. See docs/performance.md.",
+        "",
+        "## Out-of-core grace hash joins",
+        "",
+        "`spark.rapids.sql.join.grace.enabled` (default true): a",
+        "shuffled hash join whose build side exceeds",
+        "`join.grace.buildFraction` of the device budget partitions",
+        "BOTH sides by key fingerprint (the exchange's murmur3 hash",
+        "partitioning) into spillable buckets and joins co-partitioned",
+        "bucket pairs — peak HBM is one bucket's build side plus one",
+        "probe batch, so a build side 2x+ the device budget runs",
+        "ON-DEVICE instead of OOM-laddering to the host engine (beating",
+        "the reference's RequireSingleBatch build restriction). Grace is",
+        "also the OOM escalation rung directly ABOVE host fallback: a",
+        "join whose single-batch build exhausts the spill/shrink ladder",
+        "retries grace-partitioned first (`graceJoinEngaged`), and only",
+        "a grace OOM demotes to host. `graceJoinPartitions` counts the",
+        "buckets used, in per-operator metrics and the recovery block.",
+        "",
         "## Robustness: fault injection & the recovery ladder",
         "",
         "Device OOMs at any dispatch funnel (upload, concat, cached",
         "kernel, download) walk a bounded escalation ladder instead of",
         "failing: spill-some -> spill-all -> shrink the batch target ->",
+        "the operator's on-device degraded mode (a hash join retries",
+        "grace-partitioned, `spark.rapids.sql.join.grace.enabled`) ->",
         "degrade the operator subtree to the host engine",
         "(`spark.rapids.sql.oom.hostFallback.enabled`). Execution-side",
         "failures demote through partition-scoped, then stage-scoped,",

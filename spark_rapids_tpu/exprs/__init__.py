@@ -13,12 +13,14 @@ from spark_rapids_tpu.exprs.arithmetic import (  # noqa: F401
     Greatest, IntegralDivide, Least, Multiply, Pmod, Remainder, ShiftLeft,
     ShiftRight, ShiftRightUnsigned, Subtract, UnaryMinus, UnaryPositive)
 from spark_rapids_tpu.exprs.predicates import (  # noqa: F401
-    And, EqualNullSafe, EqualTo, GreaterThan, GreaterThanOrEqual, InSet,
-    IsNan, IsNotNull, IsNull, LessThan, LessThanOrEqual, Not, Or)
+    And, AtLeastNNonNulls, EqualNullSafe, EqualTo, GreaterThan,
+    GreaterThanOrEqual, InSet, IsNan, IsNotNull, IsNull, LessThan,
+    LessThanOrEqual, Not, Or)
 from spark_rapids_tpu.exprs.math import (        # noqa: F401
-    Acos, Asin, Atan, Atan2, BRound, Cbrt, Ceil, Cos, Cosh, Exp, Expm1,
-    Floor, Log, Log1p, Log2, Log10, Pow, Rint, Round, Signum, Sin, Sinh,
-    Sqrt, Tan, Tanh, ToDegrees, ToRadians)
+    Acos, Acosh, Asin, Asinh, Atan, Atan2, Atanh, BRound, Cbrt, Ceil,
+    Cos, Cosh, Exp, Expm1, Floor, Log, Log1p, Log2, Log10, Logarithm,
+    Pow, Rint, Round, Signum, Sin, Sinh, Sqrt, Tan, Tanh, ToDegrees,
+    ToRadians)
 from spark_rapids_tpu.exprs.conditional import (  # noqa: F401
     CaseWhen, Coalesce, If, KnownFloatingPointNormalized, NaNvl,
     NormalizeNaNAndZero, Nvl)

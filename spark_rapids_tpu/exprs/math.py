@@ -135,6 +135,59 @@ class Tanh(_UnaryMathD):
         return xp.tanh(x)
 
 
+class Asinh(_UnaryMathD):
+    """Total on the reals — no domain handling needed."""
+
+    def _fn(self, xp, x):
+        return xp.arcsinh(x)
+
+
+class Acosh(_UnaryMathD):
+    """Domain x >= 1; outside it java.lang.Math (and Spark) produce NaN.
+    Evaluated on a clamped-safe input so the host engine never emits
+    numpy invalid-value warnings."""
+
+    def do_columnar(self, xp, data, validity, col):
+        x = data.astype(np.float64)
+        ok = x >= 1
+        res = xp.arccosh(xp.where(ok, x, xp.asarray(1.0)))
+        return xp.where(ok, res, xp.asarray(np.nan)), validity
+
+
+class Atanh(_UnaryMathD):
+    """Domain |x| < 1 -> finite, x == ±1 -> ±Infinity (log-of-zero, as
+    java.lang.Math computes it), |x| > 1 -> NaN. Piecewise on safe
+    inputs so neither engine trips divide/invalid warnings."""
+
+    def do_columnar(self, xp, data, validity, col):
+        x = data.astype(np.float64)
+        inside = xp.abs(x) < 1
+        res = xp.arctanh(xp.where(inside, x, xp.asarray(0.0)))
+        edge = xp.where(x == 1, xp.asarray(np.inf),
+                        xp.where(x == -1, xp.asarray(-np.inf),
+                                 xp.asarray(np.nan)))
+        return xp.where(inside, res, edge), validity
+
+
+class Logarithm(BinaryExpression):
+    """log(base, x) — Spark's two-argument Logarithm. NULL outside the
+    domain (base <= 0, base == 1, or x <= 0 — the shapes where
+    ln(x)/ln(base) is undefined or a division by zero), matching the
+    unary log family's NULL-on-domain-error convention above. NaN
+    inputs fall through the comparisons to NULL as well."""
+
+    def data_type(self) -> DataType:
+        return dt.FLOAT64
+
+    def do_columnar(self, xp, l_data, l_valid, r_data, r_valid):
+        b = l_data.astype(np.float64)
+        x = r_data.astype(np.float64)
+        ok = (b > 0) & (b != 1) & (x > 0)
+        sb = xp.where(ok, b, xp.asarray(2.0))
+        sx = xp.where(ok, x, xp.asarray(1.0))
+        return xp.log(sx) / xp.log(sb), l_valid & r_valid & ok
+
+
 class ToDegrees(_UnaryMathD):
     def _fn(self, xp, x):
         return xp.degrees(x)

@@ -230,6 +230,52 @@ class IsNan(UnaryExpression):
         return xp.isnan(data), validity
 
 
+class AtLeastNNonNulls(Expression):
+    """True when at least ``n`` of the children are non-null — Spark's
+    AtLeastNNonNulls, the predicate behind ``df.na.drop(thresh=n)``.
+    Matches Spark exactly: NaN in a float/double child counts as NULL,
+    and the result itself is never null."""
+
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self._children = tuple(children)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return dt.BOOL
+
+    def _count(self, xp, cols):
+        acc = None
+        for e, c in zip(self._children, cols):
+            ok = c.validity
+            if e.data_type().is_floating:
+                ok = ok & ~xp.isnan(c.data)
+            v = ok.astype(np.int32)
+            acc = v if acc is None else acc + v
+        return acc
+
+    def eval(self, batch):
+        import jax.numpy as jnp
+        cols = [as_device_column(e.eval(batch), batch)
+                for e in self._children]
+        acc = self._count(jnp, cols)
+        if acc is None:
+            acc = jnp.zeros(batch.capacity, jnp.int32)
+        return make_column(dt.BOOL, acc >= self.n, batch.row_mask())
+
+    def eval_host(self, batch):
+        cols = [as_host_column(e.eval_host(batch), batch)
+                for e in self._children]
+        acc = self._count(np, cols)
+        if acc is None:
+            acc = np.zeros(batch.num_rows, np.int32)
+        return make_host_column(dt.BOOL, acc >= self.n,
+                                np.ones(batch.num_rows, np.bool_))
+
+
 class InSet(Expression):
     """value IN (literals) — ref GpuInSet.scala. NULL semantics: if the value
     is NULL, the result is NULL; if no match and the list has a NULL, NULL."""

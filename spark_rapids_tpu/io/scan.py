@@ -441,15 +441,17 @@ class FileScanExec(LeafExec):
 
     def prefetch_host(self, ctx, partition) -> None:
         """The separable host half of one partition: stats pruning, unit
-        decode and wire encode — everything before ``device_put``. Runs
-        on a pipeline prefetch thread; the payload lands in ``ctx.cache``
-        and the ordered consumer's :meth:`execute_device` pops it and
-        only uploads. Payload entries are ``(unit, encodes)`` /
-        ``(unit, "cached")`` for device-cache hits / ``(None, encodes)``
+        decode, wire encode AND staging-buffer pack — everything before
+        ``device_put``. Runs on a pipeline prefetch thread; the payload
+        lands in ``ctx.cache`` and the ordered consumer's
+        :meth:`execute_device` pops it and only dispatches transfers.
+        Payload entries are ``(unit, [EncodedBatch...])`` /
+        ``(unit, "cached")`` for device-cache hits / ``(None, encs)``
         for COALESCING merges (which have no per-unit identity)."""
         from spark_rapids_tpu import faults
         from spark_rapids_tpu.columnar import wire
         from spark_rapids_tpu.columnar.host import concat_host_batches
+        from spark_rapids_tpu.parallel import pipeline as PL
         m = ctx.metrics_for(self)
         rt = self._reader_type(ctx)
         rows = self._batch_rows(ctx)
@@ -467,11 +469,11 @@ class FileScanExec(LeafExec):
                     pending.append(hb)
                     pending_rows += hb.num_rows
                     if pending_rows >= rows:
-                        payload.append((None, [wire.encode_batch(
+                        payload.append((None, [wire.pack_batch(
                             concat_host_batches(pending))]))
                         pending, pending_rows = [], 0
             if pending:
-                payload.append((None, [wire.encode_batch(
+                payload.append((None, [wire.pack_batch(
                     concat_host_batches(pending))]))
         else:
             for unit in units:
@@ -481,20 +483,77 @@ class FileScanExec(LeafExec):
                     continue
                 faults.fault_point("scan")
                 payload.append((unit, [
-                    wire.encode_batch(hb)
+                    wire.pack_batch(hb)
                     for hb in _read_unit_batches(self.fmt, unit,
                                                  self.options, rows,
                                                  self._columns)]))
+        staged = sum(e.nbytes for _, item in payload
+                     if item != "cached" for e in item)
+        PL.record(ctx, "stagingBytesPrefetched", staged)
         ctx.cache[self._prefetch_key(partition)] = payload
+
+    def _upload_group_plan(self, ctx, encs):
+        """Deterministic transfer grouping for a run of encoded batches:
+        members below wire.minUploadBytes coalesce into one device_put
+        (columnar/wire.py plan_upload_groups)."""
+        from spark_rapids_tpu.columnar import wire
+        min_bytes = int(ctx.conf.get(C.WIRE_MIN_UPLOAD_BYTES))
+        if min_bytes <= 0:
+            return [[i] for i in range(len(encs))]
+        return wire.plan_upload_groups([e.nbytes for e in encs],
+                                       min_bytes)
+
+    def _upload_run(self, ctx, m, run, rows, partition, budget):
+        """Upload a run of consecutive non-cached payload entries
+        ``(unit_or_None, [EncodedBatch...])`` with tiny members grouped
+        into shared transfers. Yield order (and therefore every
+        downstream bit) is identical to per-batch uploads — grouping
+        changes only the transfer count."""
+        from spark_rapids_tpu.columnar import wire
+        flat = []                      # (entry_idx, EncodedBatch)
+        for ei, (_unit, encs) in enumerate(run):
+            for enc in encs:
+                flat.append((ei, enc))
+        # Groups are consecutive flat-index runs, so streaming them in
+        # order preserves the serial yield order exactly.
+        groups = self._upload_group_plan(ctx, [e for _, e in flat])
+        entry_batches: List[List] = [[] for _ in run]
+        started = set()
+        for g in groups:
+            with timed(m, "bufferTime"):
+                outs = wire.upload_packed_group([flat[i][1] for i in g])
+            for i, b in zip(g, outs):
+                ei = flat[i][0]
+                unit = run[ei][0]
+                if ei not in started:
+                    started.add(ei)
+                    if unit is not None:
+                        self._publish_input_file(ctx, partition,
+                                                 unit.path)
+                entry_batches[ei].append(b)
+                m.add("numOutputBatches", 1)
+                yield b
+                last_of_entry = i + 1 >= len(flat) or \
+                    flat[i + 1][0] != ei
+                if last_of_entry and unit is not None and budget > 0:
+                    key = self._unit_cache_key(unit, rows)
+                    if key is not None:
+                        DEVICE_SCAN_CACHE.put(key, entry_batches[ei],
+                                              budget)
 
     def _device_prefetched(self, ctx, m, payload, rows, partition,
                            budget):
-        """Consume a prefetched partition: upload-only, in payload order
-        (identical to the serial decode order, so results match the
-        serial path bit-for-bit)."""
-        from spark_rapids_tpu.columnar import wire
+        """Consume a prefetched partition: dispatch-only, in payload
+        order (identical to the serial decode order, so results match
+        the serial path bit-for-bit). Consecutive tiny units share one
+        transfer (wire.minUploadBytes)."""
+        run: List[tuple] = []
         for unit, item in payload:
             if unit is not None and item == "cached":
+                if run:
+                    yield from self._upload_run(ctx, m, run, rows,
+                                                partition, budget)
+                    run = []
                 hit = DEVICE_SCAN_CACHE.get(
                     self._unit_cache_key(unit, rows)) \
                     if budget > 0 else None
@@ -509,19 +568,10 @@ class FileScanExec(LeafExec):
                     yield from self._device_perfile(ctx, m, [unit], rows,
                                                     partition, budget)
                 continue
-            if unit is not None:
-                self._publish_input_file(ctx, partition, unit.path)
-            ubatches = []
-            for enc in item:
-                with timed(m, "bufferTime"):
-                    batch = wire.upload_encoded(*enc)
-                m.add("numOutputBatches", 1)
-                ubatches.append(batch)
-                yield batch
-            if unit is not None and budget > 0:
-                key = self._unit_cache_key(unit, rows)
-                if key is not None:
-                    DEVICE_SCAN_CACHE.put(key, ubatches, budget)
+            run.append((unit, item))
+        if run:
+            yield from self._upload_run(ctx, m, run, rows, partition,
+                                        budget)
 
     # -- device engine -------------------------------------------------------
     def _unit_cache_key(self, unit: ScanUnit, rows: int):
@@ -625,15 +675,16 @@ class FileScanExec(LeafExec):
         cancel = faults.get_cancel_event()
 
         def read_unit(u):
-            # Decode AND wire-encode in the worker: the upload's host half
-            # (narrowing analysis, padding, bit-packing) is CPU work that
-            # overlaps with device consumption of earlier units.
+            # Decode, wire-encode AND pack in the worker: the upload's
+            # entire host half (narrowing analysis, padding, bit-packing,
+            # staging-buffer assembly) is CPU work that overlaps with
+            # device consumption of earlier units.
             from spark_rapids_tpu.columnar import wire
             faults.set_recovery_sink(sink)
             faults.set_cancel_event(cancel)
             try:
                 faults.fault_point("scan")
-                return [wire.encode_batch(hb)
+                return [wire.pack_batch(hb)
                         for hb in _read_unit_batches(self.fmt, u,
                                                      self.options, rows,
                                                      self._columns)]
@@ -641,7 +692,6 @@ class FileScanExec(LeafExec):
                 faults.set_cancel_event(None)
                 faults.set_recovery_sink(None)
 
-        from spark_rapids_tpu.columnar import wire
         with concurrent.futures.ThreadPoolExecutor(
                 max_workers=window) as pool:
             inflight = []          # [(unit, future)] bounded by `window`
@@ -656,18 +706,8 @@ class FileScanExec(LeafExec):
                 nxt = next(it, None)
                 if nxt is not None:
                     inflight.append((nxt, pool.submit(read_unit, nxt)))
-                self._publish_input_file(ctx, partition, unit.path)
-                ubatches = []
-                for enc in encoded:
-                    with timed(m, "bufferTime"):
-                        batch = wire.upload_encoded(*enc)
-                    m.add("numOutputBatches", 1)
-                    ubatches.append(batch)
-                    yield batch
-                if budget > 0:
-                    key = self._unit_cache_key(unit, rows)
-                    if key is not None:
-                        DEVICE_SCAN_CACHE.put(key, ubatches, budget)
+                yield from self._upload_run(ctx, m, [(unit, encoded)],
+                                            rows, partition, budget)
 
     def _device_coalescing(self, ctx, m, units, rows):
         """Concatenate small units' rows into fewer, larger uploads

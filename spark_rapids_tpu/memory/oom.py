@@ -29,8 +29,12 @@ If the ladder is exhausted the dispatch raises :class:`OomRetryExhausted`
 — whose message deliberately does NOT carry the OOM markers, so nested
 ``retry_on_oom`` frames propagate it instead of re-running the ladder.
 The operator layer (ops/base.py ``execute_device_recovering``) catches it
-and degrades that operator subtree to the host engine — the fourth rung,
-mirroring the reference's always-available CPU fallback.
+and demotes in two further rungs: first the operator's ON-DEVICE degraded
+mode (``Exec._grace_retry`` — the hash join's spill-partitioned grace
+path, ops/join.py, which keeps a build side far past the device budget on
+the accelerator), and only then host-engine degradation of the operator
+subtree — mirroring the reference's always-available CPU fallback, but
+one rung later than it.
 
 The wrapped operations are pure batch->batch (no consumed iterator
 state), so every retry is safe. The active catalog is registered

@@ -482,9 +482,11 @@ class SpillableBatch:
         self._catalog = catalog
         self._id = catalog.add_batch(batch, priority)
         self._closed = False
-        # Host-known row capacity (static shape) — lets consumers group
-        # handles by size without any device sync.
+        # Host-known row capacity and registered byte size (static
+        # shapes) — let consumers (out-of-core bucketing, grace joins)
+        # group handles by size without any device sync.
         self.capacity = batch.capacity
+        self.size_bytes = batch.device_size_bytes()
 
     def get(self) -> DeviceBatch:
         return self._catalog.acquire_batch(self._id)

@@ -270,18 +270,29 @@ class Exec:
             if not is_stage_boundary(c):
                 c.prefetch_host(ctx, partition)
 
+    def _grace_retry(self, ctx: ExecContext, partition: int):
+        """Operator-specific on-device OOM rung ABOVE host fallback:
+        return a replacement device iterator (e.g. the hash join's
+        grace-partitioned path, ops/join.py) or None. Only consulted
+        when the spill/shrink ladder is exhausted before the first
+        output batch."""
+        return None
+
     # -- recovery ------------------------------------------------------------
     def execute_device_recovering(self, ctx: ExecContext,
                                   partition: int) -> Iterator[DeviceBatch]:
-        """Device stream with the FINAL OOM escalation rung: when the
+        """Device stream with the FINAL OOM escalation rungs: when the
         device path dies on an exhausted spill/shrink ladder
         (memory/oom.py OomRetryExhausted) BEFORE producing its first
-        batch, re-run this operator subtree on the host engine and
-        upload the results — the reference's operator-by-operator CPU
-        fallback, applied at the dispatch funnels that pull child
-        streams (collect, exchanges, broadcasts). After the first batch
-        is out, consumers have already observed device output, so a
-        mid-stream failure propagates instead of duplicating rows."""
+        batch, first offer the operator its on-device degraded mode
+        (``_grace_retry`` — the hash join's spill-partitioned grace
+        path), and only if that is unavailable or also OOMs re-run this
+        operator subtree on the host engine and upload the results —
+        the reference's operator-by-operator CPU fallback, applied at
+        the dispatch funnels that pull child streams (collect,
+        exchanges, broadcasts). After the first batch is out, consumers
+        have already observed device output, so a mid-stream failure
+        propagates instead of duplicating rows."""
         from spark_rapids_tpu import config as C, faults
         from spark_rapids_tpu.memory.oom import OomRetryExhausted
         it = self.execute_device(ctx, partition)
@@ -290,8 +301,25 @@ class Exec:
         except StopIteration:
             return
         except OomRetryExhausted as e:
+            grace_it = self._grace_retry(ctx, partition)
+            if grace_it is not None:
+                import logging
+                logging.getLogger("spark_rapids_tpu").warning(
+                    "OOM ladder exhausted in %s partition %d; retrying "
+                    "on-device via the grace-partitioned path: %s",
+                    self.name, partition, e)
+                try:
+                    first = next(grace_it)
+                except StopIteration:
+                    return
+                except OomRetryExhausted as e2:
+                    e = e2      # grace also OOMed: host fallback next
+                else:
+                    yield first
+                    yield from grace_it
+                    return
             if not bool(ctx.conf.get(C.OOM_HOST_FALLBACK)):
-                raise
+                raise e
             try:
                 host_iter = self.execute_host(ctx, partition)
             except (NotImplementedError, AssertionError):
@@ -409,8 +437,12 @@ class Exec:
         names = tuple(n for n, _ in self.schema)
         if device:
             from spark_rapids_tpu import config as C
+            from spark_rapids_tpu.columnar import wire
             from spark_rapids_tpu.columnar.host import download_batches
             from spark_rapids_tpu.memory.stores import get_tpu_semaphore
+            # Adopt this query's wire codec selection (process-global,
+            # spark.rapids.sql.wire.codec) before any upload happens.
+            wire.maybe_configure(ctx.conf)
             # Task admission (GpuSemaphore.scala:74-87): at most
             # concurrentTpuTasks collects issue device work at once, so
             # concurrent queries can't oversubscribe HBM.

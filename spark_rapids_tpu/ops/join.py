@@ -734,12 +734,116 @@ class ShuffledHashJoinExec(Exec, _JoinKernelMixin):
                     yield self._null_extend(
                         pbatch, pbatch.row_mask(), built, build_right)
             return
+        total_bytes = sum(b.device_size_bytes() for b in bbatches)
+        grace_budget = self._grace_bucket_budget(ctx)
+        forced = bool(ctx.cache.get(self._grace_force_key()))
+        if grace_budget is not None and (forced
+                                         or total_bytes > grace_budget):
+            yield from self._grace_join(
+                ctx, bbatches, probe_iter, build_child, probe_child,
+                build_keys, probe_keys, build_right, total_bytes,
+                grace_budget)
+            return
         single = coalesce_to_single_batch(bbatches)
         built = build_side(single, self._key_ordinals(build_child,
                                                       build_keys))
         yield from self._device_join_stream(
             ctx, built, probe_iter,
             self._key_ordinals(probe_child, probe_keys), build_right)
+
+    # -- out-of-core grace hash join -----------------------------------------
+    def _grace_force_key(self) -> str:
+        return f"grace-join-force:{id(self):x}"
+
+    def _grace_bucket_budget(self, ctx) -> Optional[int]:
+        """Per-bucket byte budget when the grace path is available for
+        this join, else None. The same number is the build-side size
+        past which grace engages proactively."""
+        from spark_rapids_tpu import config as C
+        if not bool(ctx.conf.get(C.JOIN_GRACE_ENABLED)):
+            return None
+        if self.join_type == "cross" or not self.left_keys:
+            return None
+        frac = float(ctx.conf.get(C.JOIN_GRACE_BUILD_FRACTION))
+        return max(int(ctx.catalog.device_budget * frac), 1 << 16)
+
+    def _grace_retry(self, ctx, partition):
+        """The OOM-ladder rung ABOVE host fallback (ops/base.py calls
+        this when the device path dies on an exhausted spill/shrink
+        ladder): force the grace-partitioned path for this join and
+        re-run on device. Returns the retry iterator, or None when
+        grace is unavailable / already forced (then host fallback is
+        next, as before)."""
+        from spark_rapids_tpu import faults
+        if self._grace_bucket_budget(ctx) is None:
+            return None
+        key = self._grace_force_key()
+        if ctx.cache.get(key):
+            return None                 # grace itself OOMed: demote on
+        ctx.cache[key] = True
+        faults.record("graceJoinEngaged")
+        ctx.metrics_for(self).add("graceJoinEngaged", 1)
+        return self.execute_device(ctx, partition)
+
+    def _grace_join(self, ctx, bbatches, probe_iter, build_child,
+                    probe_child, build_keys, probe_keys,
+                    build_right: bool, total_bytes: int,
+                    bucket_budget: int):
+        """Spill-partitioned grace hash join (the Grace/hybrid-hash
+        classic, TPU-shaped): BOTH sides partition by the murmur3 key
+        fingerprint through the staged exchange into spillable buckets
+        (equal keys land in the same bucket on both sides by
+        construction), then co-partitioned bucket pairs run the normal
+        build/probe kernel one at a time. Peak HBM is one bucket's
+        build side + one probe batch; everything else rides the spill
+        tiers. Runs build sides FAR past the device budget on-device —
+        beating the reference's RequireSingleBatch build restriction
+        (GpuShuffledHashJoinExec / SURVEY §5.7)."""
+        from spark_rapids_tpu import config as C, faults
+        from spark_rapids_tpu.memory.stores import PRIORITY_SHUFFLE_OUTPUT
+        from spark_rapids_tpu.ops.sort import (stage_spillables,
+                                               staged_exchange)
+        from spark_rapids_tpu.parallel.partitioning import HashPartitioning
+        m = ctx.metrics_for(self)
+        nb = max(2, -(-total_bytes // bucket_budget))
+        nb = min(nb, max(int(ctx.conf.get(C.JOIN_GRACE_MAX_PARTITIONS)),
+                         2))
+        m.add("graceJoinPartitions", nb)
+        faults.record("graceJoinPartitions", nb)
+        bords = self._key_ordinals(build_child, build_keys)
+        pords = self._key_ordinals(probe_child, probe_keys)
+        bspill, _ = stage_spillables(ctx, iter(bbatches))
+        pspill, _ = stage_spillables(ctx, probe_iter)
+        bex = staged_exchange(bspill, build_child.schema,
+                              HashPartitioning(list(build_keys), nb))
+        pex = staged_exchange(pspill, probe_child.schema,
+                              HashPartitioning(list(probe_keys), nb))
+        try:
+            for p in range(nb):
+                bucket = list(bex.execute_device(ctx, p))
+                probe_bucket = pex.execute_device(ctx, p)
+                if not bucket:
+                    # Empty build bucket: mirror the empty-build-side
+                    # semantics per bucket (each probe row lives in
+                    # exactly one bucket, so emitting here is exact).
+                    if self.join_type == "anti":
+                        yield from probe_bucket
+                    elif self.join_type in ("left", "right", "full"):
+                        empty = _empty_like(build_child.schema)
+                        built = build_side(empty,
+                                           list(range(len(bords))))
+                        for pbatch in probe_bucket:
+                            yield self._null_extend(
+                                pbatch, pbatch.row_mask(), built,
+                                build_right)
+                    continue
+                built = build_side(coalesce_to_single_batch(bucket),
+                                   bords)
+                yield from self._device_join_stream(
+                    ctx, built, probe_bucket, pords, build_right)
+        finally:
+            for sb in bspill + pspill:
+                sb.close()
 
     # -- host oracle ---------------------------------------------------------
     def execute_host(self, ctx, partition):
@@ -749,6 +853,14 @@ class ShuffledHashJoinExec(Exec, _JoinKernelMixin):
 class BroadcastHashJoinExec(ShuffledHashJoinExec):
     """Build side pre-broadcast (wrapped in BroadcastExchangeExec); probe
     side streams its partitions (GpuBroadcastHashJoinExec)."""
+
+    def _grace_retry(self, ctx, partition):
+        # A broadcast build side is shared across every probe partition;
+        # grace-partitioning it per partition would rebuild the table N
+        # times. OOM here demotes straight to host fallback (the planner
+        # picked broadcast because the build side was SMALL — an OOM is
+        # device pressure, not build-side size).
+        return None
 
     def num_partitions(self, ctx) -> int:
         probe = self.children[0] if self.join_type != "right" else \

@@ -96,6 +96,34 @@ class _SpillableListSource(Exec):
         raise AssertionError("device-only staging source")
 
 
+def stage_spillables(ctx, child_iter):
+    """Register a batch stream as catalog spillables (the out-of-core
+    staging step shared by sort/window bucketing and grace joins).
+    Returns (spillables, total device bytes)."""
+    from spark_rapids_tpu.memory.stores import (
+        PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+    spillables = []
+    total_bytes = 0
+    for b in child_iter:
+        total_bytes += b.device_size_bytes()
+        spillables.append(SpillableBatch(ctx.catalog, b,
+                                         PRIORITY_SHUFFLE_OUTPUT))
+    return spillables, total_bytes
+
+
+def staged_exchange(spillables, schema, partitioning):
+    """An exchange over already-staged spillables: the generic bucketing
+    device for out-of-core operators. Sort/window feed it a
+    RangePartitioning (equal keys share a bucket, buckets stream in
+    range order); grace hash joins feed it a HashPartitioning over the
+    join keys so BOTH sides bucket by the same key fingerprints
+    (ops/join.py). ``allow_coalesce`` stays off — bucket identity is
+    load-bearing for every caller."""
+    from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+    return ShuffleExchangeExec(_SpillableListSource(schema, spillables),
+                               partitioning)
+
+
 def out_of_core_partition(ctx, metrics, child_iter, schema,
                           split_orders: Sequence[SortOrder], batch_fn):
     """Shared out-of-core scaffold (SortExec's sample-sort shape, also
@@ -105,15 +133,9 @@ def out_of_core_partition(ctx, metrics, child_iter, schema,
     the exchange into bounded spillable buckets and run ``batch_fn`` per
     bucket (equal keys always share a bucket). Yields output batches."""
     from spark_rapids_tpu.memory.oom import retry_on_oom
-    from spark_rapids_tpu.memory.stores import (
-        PRIORITY_SHUFFLE_OUTPUT, SpillableBatch)
+    from spark_rapids_tpu.parallel.partitioning import RangePartitioning
     m = metrics
-    spillables = []
-    total_bytes = 0
-    for b in child_iter:
-        total_bytes += b.device_size_bytes()
-        spillables.append(SpillableBatch(ctx.catalog, b,
-                                         PRIORITY_SHUFFLE_OUTPUT))
+    spillables, total_bytes = stage_spillables(ctx, child_iter)
     if not spillables:
         return
     bucket_budget = max(ctx.catalog.device_budget // 3, 1 << 16)
@@ -127,12 +149,10 @@ def out_of_core_partition(ctx, metrics, child_iter, schema,
         m.add("numOutputBatches", 1)
         yield out
         return
-    from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
-    from spark_rapids_tpu.parallel.partitioning import RangePartitioning
     nb = max(2, -(-total_bytes // bucket_budget))
     m.add("outOfCoreBuckets", nb)
-    src = _SpillableListSource(schema, spillables)
-    ex = ShuffleExchangeExec(src, RangePartitioning(list(split_orders), nb))
+    ex = staged_exchange(spillables, schema,
+                         RangePartitioning(list(split_orders), nb))
     try:
         for p in range(nb):
             bucket = list(ex.execute_device(ctx, p))

@@ -74,6 +74,17 @@ def _record(ctx, name: str, amount: float) -> None:
         metrics_entry(ctx).add(name, amount)
 
 
+def record(ctx, name: str, amount: float) -> None:
+    """Public counter hook for prefetch-side producers (the scan's
+    wire-encode + staging-buffer pack, io/scan.py): counts land in both
+    the process-global pipeline counters and the per-query
+    ``Pipeline@query`` metrics entry. With the ingest fast path the
+    prefetch pool stages fully-packed upload buffers
+    (``stagingBytesPrefetched``), so the ordered consumer's only work
+    per partition is device_put transfers + jitted decode dispatches."""
+    _record(ctx, name, amount)
+
+
 def counters() -> Dict[str, float]:
     """Process-global pipeline counters (bench.py's ``pipeline`` JSON
     block), with the derived overlapRatio folded in."""

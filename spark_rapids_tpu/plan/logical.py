@@ -343,6 +343,19 @@ def log2_col(c) -> Column:
     return Column(("log2", _as_col(c)))
 
 
+def logb(base, c) -> Column:
+    """log(base, x) — Spark's two-argument log (Logarithm). The base can
+    be a column or a literal."""
+    return Column(("logb", _as_col(base), _as_col(c)))
+
+
+def at_least_n_non_nulls(n: int, *cs) -> Column:
+    """True when at least n of the columns are non-null (NaN counts as
+    null for floats) — the df.na.drop(thresh=n) predicate."""
+    return Column(("at_least_n_non_nulls", int(n),
+                   tuple(_as_col(c) for c in cs)))
+
+
 def pow_col(c, p) -> Column:
     return Column(("pow", _as_col(c), _as_col(p)))
 
@@ -387,6 +400,9 @@ atan_col = _unary_fn("atan")
 sinh_col = _unary_fn("sinh")
 cosh_col = _unary_fn("cosh")
 tanh_col = _unary_fn("tanh")
+asinh_col = _unary_fn("asinh")
+acosh_col = _unary_fn("acosh")
+atanh_col = _unary_fn("atanh")
 cbrt_col = _unary_fn("cbrt")
 expm1_col = _unary_fn("expm1")
 log1p_col = _unary_fn("log1p")
@@ -754,12 +770,17 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.Pmod(rec(node[1]), rec(node[2]))
     if kind == "pow":
         return E.Pow(rec(node[1]), rec(node[2]))
+    if kind == "logb":
+        return E.Logarithm(rec(node[1]), rec(node[2]))
+    if kind == "at_least_n_non_nulls":
+        return E.AtLeastNNonNulls(node[1], *[rec(x) for x in node[2]])
     _UNARY_TABLE = {
         "floor": E.Floor, "ceil": E.Ceil, "exp": E.Exp, "log": E.Log,
         "log10": E.Log10, "log2": E.Log2, "log1p": E.Log1p,
         "expm1": E.Expm1, "cbrt": E.Cbrt, "sin": E.Sin, "cos": E.Cos,
         "tan": E.Tan, "asin": E.Asin, "acos": E.Acos, "atan": E.Atan,
         "sinh": E.Sinh, "cosh": E.Cosh, "tanh": E.Tanh,
+        "asinh": E.Asinh, "acosh": E.Acosh, "atanh": E.Atanh,
         "degrees": E.ToDegrees, "radians": E.ToRadians, "rint": E.Rint,
         "signum": E.Signum,
         "quarter": E.Quarter, "dayofweek": E.DayOfWeek,

@@ -215,6 +215,72 @@ class TestMath:
         check_expr(E.Pow(lit(2.0), lit(10.0)), INT_BATCH,
                    [1024.0] * 6, approx_float=True)
 
+    def test_inverse_hyperbolics_datagen(self):
+        """asinh/acosh/atanh dual-engine parity over adversarial doubles
+        (NaN/±inf/±0/huge), with pandas-style numpy oracles (VERDICT
+        expression-gap satellite)."""
+        from data_gen import DoubleGen, unary_op_batch
+        b = unary_op_batch(DoubleGen(), n=96, seed=11)
+        for cls in (E.Asinh, E.Acosh, E.Atanh):
+            check_expr(cls(Ref(0, dt.FLOAT64)), b, approx_float=True)
+
+    def test_acosh_atanh_domains(self):
+        b = make_batch([("x", dt.FLOAT64)],
+                       {"x": [1.0, 0.5, -2.0, None, 2.0]})
+        got = check_expr(E.Acosh(Ref(0, dt.FLOAT64)), b,
+                         approx_float=True)
+        assert got[0] == 0.0 and math.isnan(got[1]) \
+            and math.isnan(got[2]) and got[3] is None
+        b = make_batch([("x", dt.FLOAT64)],
+                       {"x": [0.5, 1.0, -1.0, 2.0, None]})
+        got = check_expr(E.Atanh(Ref(0, dt.FLOAT64)), b,
+                         approx_float=True)
+        assert abs(got[0] - math.atanh(0.5)) < 1e-12
+        assert got[1] == math.inf and got[2] == -math.inf
+        assert math.isnan(got[3]) and got[4] is None
+
+    def test_logarithm_arbitrary_base(self):
+        """log(base, x): NULL outside the domain (base <= 0, base == 1,
+        x <= 0), exact ratios inside it; fuzzed dual-engine parity."""
+        b = make_batch([("x", dt.FLOAT64)],
+                       {"x": [8.0, 0.5, -1.0, 0.0, None]})
+        check_expr(E.Logarithm(lit(2.0), Ref(0, dt.FLOAT64)), b,
+                   [3.0, -1.0, None, None, None], approx_float=True)
+        b = make_batch([("b", dt.FLOAT64), ("x", dt.FLOAT64)],
+                       {"b": [10.0, 1.0, -2.0, 0.5, None],
+                        "x": [100.0, 5.0, 5.0, 4.0, 2.0]})
+        check_expr(E.Logarithm(Ref(0, dt.FLOAT64), Ref(1, dt.FLOAT64)),
+                   b, [2.0, None, None, -2.0, None], approx_float=True)
+        from data_gen import DoubleGen, binary_op_batch
+        fuzz = binary_op_batch(DoubleGen(), DoubleGen(), n=96, seed=12)
+        check_expr(E.Logarithm(Ref(0, dt.FLOAT64), Ref(1, dt.FLOAT64)),
+                   fuzz, approx_float=True)
+
+
+class TestAtLeastNNonNulls:
+    def test_basic_and_nan_counts_as_null(self):
+        b = make_batch([("a", dt.FLOAT64), ("b", dt.INT64),
+                        ("c", dt.STRING)],
+                       {"a": [1.0, None, float("nan"), 2.0],
+                        "b": [1, None, 3, None],
+                        "c": ["x", "y", None, None]})
+        exprs = [Ref(0, dt.FLOAT64), Ref(1, dt.INT64), Ref(2, dt.STRING)]
+        check_expr(E.AtLeastNNonNulls(2, *exprs), b,
+                   [True, False, False, False])
+        check_expr(E.AtLeastNNonNulls(1, *exprs), b,
+                   [True, True, True, True])
+        check_expr(E.AtLeastNNonNulls(0, *exprs), b, [True] * 4)
+        check_expr(E.AtLeastNNonNulls(4, *exprs), b, [False] * 4)
+
+    def test_datagen_parity(self):
+        from data_gen import (DoubleGen, IntegerGen, StringGen,
+                              gen_batch)
+        b = gen_batch([("a", DoubleGen()), ("b", IntegerGen()),
+                       ("c", StringGen())], 96, seed=13)
+        check_expr(E.AtLeastNNonNulls(
+            2, Ref(0, dt.FLOAT64), Ref(1, dt.INT32),
+            Ref(2, dt.STRING)), b)
+
 
 class TestConditional:
     def test_if_null_pred_takes_else(self):

@@ -1,0 +1,182 @@
+"""Out-of-core grace hash joins (ops/join.py): a shuffled hash join
+whose build side exceeds the device budget partitions BOTH sides by key
+fingerprint into spillable buckets and joins co-partitioned bucket pairs
+ON DEVICE — zero host fallbacks, bit-identical to the in-budget run,
+including under seeded fault schedules. Also: the grace path is the OOM
+escalation rung directly ABOVE host fallback (ops/base.py
+execute_device_recovering)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu import FLOAT64, INT64
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.memory import oom
+from spark_rapids_tpu.plan.logical import col
+
+
+@pytest.fixture(autouse=True)
+def clean_fault_state():
+    faults.configure("")
+    faults.reset_counters()
+    oom.reset_degradation()
+    yield
+    faults.configure("")
+    faults.reset_counters()
+    oom.reset_degradation()
+
+
+# The scheduler floors every managed query's catalog budget at 1 MiB,
+# so "2x the device budget" means a >= 2 MiB build side: ~110k rows of
+# (int64 key, float64 value) is ~2.6 MiB registered (incl. validity).
+_N = 110_000
+_KEYS = 30_000
+_BUDGET = 1 << 20
+
+
+def _data():
+    rng = np.random.default_rng(42)
+    left = {"k": rng.integers(0, _KEYS, _N).tolist(),
+            "v": rng.normal(size=_N).tolist()}
+    right = {"k": rng.integers(0, _KEYS, _N).tolist(),
+             "w": rng.normal(size=_N).tolist()}
+    return left, right
+
+
+_LEFT, _RIGHT = _data()
+
+
+def _run(budget, how="inner", chaos="", grace=True):
+    s = TpuSession()
+    s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    s.set("spark.rapids.sql.aqe.replan.enabled", False)
+    s.set("spark.rapids.sql.cost.enabled", False)
+    s.set("spark.rapids.sql.shuffle.partitions", 1)
+    s.set("spark.rapids.sql.test.faults", chaos)
+    s.set("spark.rapids.sql.test.faults.seed", 7)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    s.set("spark.rapids.sql.join.grace.enabled", grace)
+    if budget:
+        s.set("spark.rapids.memory.tpu.budgetBytes", budget)
+    left = s.create_dataframe(_LEFT, [("k", INT64), ("v", FLOAT64)],
+                              num_partitions=4)
+    right = s.create_dataframe(_RIGHT, [("k", INT64), ("w", FLOAT64)],
+                               num_partitions=4)
+    df = left.join(right, "k", how)
+    rows = df.collect()
+    mets = {}
+    for key, m in df._physical().last_ctx.metrics.items():
+        for name, v in m.values.items():
+            if name in ("graceJoinPartitions", "graceJoinEngaged",
+                        "hostFallbacks"):
+                mets[name] = mets.get(name, 0) + v
+    return rows, mets
+
+
+def _assert_bit_identical(got, want):
+    """Join outputs are gathers of the input values, so even the float
+    columns must match bit-for-bit — only the emission ORDER may differ
+    between the single-batch and bucketed paths."""
+    assert sorted(map(repr, got)) == sorted(map(repr, want))
+
+
+class TestGraceJoin:
+    def test_build_side_2x_budget_runs_on_device(self):
+        want, m0 = _run(None)
+        assert m0.get("graceJoinPartitions", 0) == 0
+        got, m1 = _run(_BUDGET)
+        assert m1.get("graceJoinPartitions", 0) > 0, m1
+        assert m1.get("hostFallbacks", 0) == 0, m1
+        _assert_bit_identical(got, want)
+
+    @pytest.mark.parametrize("how", [
+        pytest.param("left", marks=pytest.mark.slow),
+        "semi", "anti",
+        pytest.param("full", marks=pytest.mark.slow),
+    ])
+    def test_join_types_bit_identical(self, how):
+        want, _ = _run(None, how)
+        got, m = _run(_BUDGET, how)
+        assert m.get("graceJoinPartitions", 0) > 0, m
+        assert m.get("hostFallbacks", 0) == 0, m
+        _assert_bit_identical(got, want)
+
+    @pytest.mark.parametrize("chaos", [
+        "oom@upload:1,oom@kernel:1,oom@concat:1",
+        pytest.param("transient@exchange.flush:1,oom@kernel:1",
+                     marks=pytest.mark.slow),
+        pytest.param("corrupt@wire:2,oom@upload:1",
+                     marks=pytest.mark.slow),
+    ])
+    def test_grace_under_chaos_bit_identical(self, chaos):
+        want, _ = _run(_BUDGET)
+        faults.reset_counters()
+        got, m = _run(_BUDGET, chaos=chaos)
+        assert faults.counters().get("faultsInjected", 0) > 0
+        assert m.get("graceJoinPartitions", 0) > 0, m
+        assert m.get("hostFallbacks", 0) == 0, m
+        _assert_bit_identical(got, want)
+
+    def test_grace_disabled_still_correct(self):
+        """Kill switch: with grace off the join still completes through
+        the ladder (or plain execution) and matches, with zero grace
+        buckets."""
+        want, _ = _run(None)
+        got, m = _run(_BUDGET, grace=False)
+        assert m.get("graceJoinPartitions", 0) == 0
+        _assert_bit_identical(got, want)
+
+
+class TestGraceOomRung:
+    def test_ladder_exhaustion_engages_grace_before_host(self,
+                                                         monkeypatch):
+        """OomRetryExhausted from the join's device path must retry
+        through the grace-partitioned rung (graceJoinEngaged) — host
+        fallback stays the LAST resort."""
+        from spark_rapids_tpu.memory.oom import OomRetryExhausted
+        from spark_rapids_tpu.ops.join import ShuffledHashJoinExec
+        real = ShuffledHashJoinExec.execute_device
+
+        def oom_until_grace(self, ctx, partition):
+            if not ctx.cache.get(self._grace_force_key()):
+                raise OomRetryExhausted(MemoryError("injected"),
+                                        ["spill-all"])
+            yield from real(self, ctx, partition)
+
+        monkeypatch.setattr(ShuffledHashJoinExec, "execute_device",
+                            oom_until_grace)
+        want_rows = _run_small(grace_expected=True)
+        monkeypatch.setattr(ShuffledHashJoinExec, "execute_device", real)
+        plain = _run_small(grace_expected=False)
+        _assert_bit_identical(want_rows, plain)
+
+
+def _run_small(grace_expected: bool):
+    rng = np.random.default_rng(5)
+    n = 4_000
+    s = TpuSession()
+    s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    s.set("spark.rapids.sql.aqe.replan.enabled", False)
+    s.set("spark.rapids.sql.cost.enabled", False)
+    s.set("spark.rapids.sql.shuffle.partitions", 1)
+    left = s.create_dataframe(
+        {"k": rng.integers(0, 500, n).tolist(),
+         "v": rng.normal(size=n).tolist()},
+        [("k", INT64), ("v", FLOAT64)], num_partitions=2)
+    right = s.create_dataframe(
+        {"k": rng.integers(0, 500, n).tolist(),
+         "w": rng.normal(size=n).tolist()},
+        [("k", INT64), ("w", FLOAT64)], num_partitions=2)
+    df = left.join(right, "k", "inner")
+    rows = df.collect()
+    engaged = sum(
+        m.values.get("graceJoinEngaged", 0) + m.values.get(
+            "graceJoinPartitions", 0)
+        for m in df._physical().last_ctx.metrics.values())
+    if grace_expected:
+        assert engaged > 0
+        assert faults.counters().get("graceJoinEngaged", 0) > 0
+    else:
+        assert engaged == 0
+    return rows
