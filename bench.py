@@ -207,6 +207,38 @@ def _scan_probe(tpch_dir: str) -> dict:
     }
 
 
+def _trace_probe(tpch_dir: str, trace_path: str) -> dict:
+    """One traced q3 run through the flight recorder: where the
+    wall-clock went by span category, plus the Chrome trace JSON written
+    as the benchmark's artifact (tier1.yml uploads it)."""
+    from spark_rapids_tpu import monitoring
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
+
+    s = _session()
+    s.set("spark.rapids.sql.trace.enabled", True)
+    df = tpch.QUERIES["q3"](s, tpch_dir)
+    monitoring.reset()
+    DEVICE_SCAN_CACHE.clear()   # the upload funnel must actually run
+    t0 = time.perf_counter()
+    df.collect()
+    secs = time.perf_counter() - t0
+    df.trace_export(trace_path)
+    snap = monitoring.snapshot()
+    breakdown = {cat: agg["ms"]
+                 for cat, agg in snap["categories"].items()}
+    monitoring.configure(False)
+    monitoring.reset()
+    return {
+        "query": "q3",
+        "seconds": round(secs, 4),
+        "category_ms": breakdown,
+        "instants": snap["instants"],
+        "dropped_events": snap["droppedEvents"],
+        "artifact": trace_path,
+    }
+
+
 def _concurrency_probe(tpch_dir: str, n: int) -> dict:
     """N-query throughput: N fresh sessions run hot q6 serially, then
     the same N concurrently through the scheduler (each on its own
@@ -335,6 +367,12 @@ def main():
         # microbench that produces the scan_gb_per_sec headline.
         "wire": {},
         "scan_bench": {},
+        # Query flight recorder (spark_rapids_tpu/monitoring/): one
+        # TRACED q3 run after the timing loop — the span-category wall
+        # breakdown (queued/host-prefetch/device-compute/upload/
+        # shuffle/recovery) plus the Chrome trace JSON artifact path
+        # (loads in Perfetto / chrome://tracing).
+        "trace": {},
     }
     with _LOCK:
         _STATE["out"] = out
@@ -431,6 +469,20 @@ def main():
                 out["scan_gb_per_sec"] = probe["gb_per_sec"]
                 out["scan_frac_of_hbm_bw"] = round(
                     probe["gb_per_sec"] / HBM_GB_PER_SEC, 5)
+
+    # One TRACED q3 run (outside the timing loop — tracing costs ~µs per
+    # span but the timed medians stay untouched): exports the Chrome
+    # trace artifact and the span-category wall breakdown.
+    if "q3" in _STATE["ok"] and _remaining(budget) > 30:
+        trace_path = os.environ.get("BENCH_TRACE_PATH",
+                                    "/tmp/srt_bench_q3_trace.json")
+        try:
+            probe = _trace_probe(packs["q3"][1], trace_path)
+            with _LOCK:
+                out["trace"] = probe
+        except Exception as e:     # the headline must survive a probe bug
+            with _LOCK:
+                out["trace"] = {"error": f"{type(e).__name__}: {e}"}
 
     # N-query concurrent throughput vs serial (the scheduler's reason to
     # exist): N fresh sessions run the same hot query back-to-back and

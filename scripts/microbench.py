@@ -146,6 +146,37 @@ def main():
     from spark_rapids_tpu.ops import kernel_cache as kc
     print("kernel cache:", kc.cache().stats())
 
+    trace_overhead()
+
+
+def trace_overhead(calls: int = 200_000, budget_ns: float = 3000.0):
+    """Bound the flight recorder's DISABLED span cost: the no-op path is
+    one global load + a shared no-op context manager, so a per-partition
+    dispatch wearing a span must cost nanoseconds when tracing is off.
+    Prints ns/call for disabled vs enabled and asserts the disabled path
+    stays under ``budget_ns`` (generous — real cost is tens of ns; the
+    bound only exists to catch an accidental allocation/lock creeping
+    into the hot path)."""
+    from spark_rapids_tpu import monitoring
+
+    def loop():
+        t0 = time.perf_counter_ns()
+        for _ in range(calls):
+            with monitoring.span("bench", "device-compute"):
+                pass
+        return (time.perf_counter_ns() - t0) / calls
+
+    monitoring.configure(False)
+    disabled = min(loop() for _ in range(3))
+    monitoring.configure(True, monitoring.LEVEL_OPERATOR)
+    enabled = min(loop() for _ in range(3))
+    monitoring.configure(False)
+    monitoring.reset()
+    print(f"trace span: disabled={disabled:.0f} ns/call "
+          f"enabled={enabled:.0f} ns/call")
+    assert disabled < budget_ns, \
+        f"no-op trace span costs {disabled:.0f} ns/call (> {budget_ns})"
+
 
 if __name__ == "__main__":
     main()

@@ -3,16 +3,31 @@ of the q3 pipeline ending in a cheap count, so stage N+1 minus stage N
 approximates the device cost of the added operator. Hot (scan cache on),
 second run of each stage is reported.
 
+Rebased on the flight recorder: the per-stage wall comes from the
+query's ``collect`` span (monitoring/recorder.py) instead of an ad-hoc
+perf_counter pair, so the number is exactly what trace_export renders —
+and a Chrome trace of any stage is one ``monitoring.export_chrome``
+call away.
+
 Usage: TPCH_SF=1 python scripts/q3_stages.py
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _collect_span_secs() -> float:
+    """Wall of the newest top-level collect span across all rings."""
+    from spark_rapids_tpu import monitoring
+    spans = [e for e in monitoring.events()
+             if e[0] == "X" and e[1] == "collect" and e[2] == "query"]
+    assert spans, "no collect span recorded (trace disabled?)"
+    return spans[-1][4] / 1e9
+
+
 def main():
+    from spark_rapids_tpu import monitoring
     from spark_rapids_tpu.api.dataframe import TpuSession
     from spark_rapids_tpu.benchmarks import tpch
     from spark_rapids_tpu.plan.logical import agg_count, agg_sum, col, \
@@ -24,6 +39,7 @@ def main():
     s = TpuSession()
     s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
     s.set("spark.rapids.sql.hasNans", False)
+    s.set("spark.rapids.sql.trace.enabled", True)
     if os.environ.get("SRT_SHUFFLE_PARTS"):
         s.set("spark.rapids.sql.shuffle.partitions",
               int(os.environ["SRT_SHUFFLE_PARTS"]))
@@ -60,9 +76,9 @@ def main():
     prev = 0.0
     for name, df in stages():
         df.collect()                      # compile + cold
-        t0 = time.perf_counter()
+        monitoring.reset()
         out = df.collect()
-        dt = time.perf_counter() - t0
+        dt = _collect_span_secs()
         print(f"{name:10s} hot={dt:7.3f}s  delta={dt - prev:7.3f}s "
               f"-> {out[:1]}")
         prev = dt

@@ -1,18 +1,21 @@
 """Host-sync profiler: on this tunneled chip a device->host read costs a
 ~70ms round trip, so query wall time ~= device compute + 70ms * syncs.
-This wraps every sync funnel (jax.device_get, ArrayImpl.__array__ /
-__int__ / __float__ / __bool__) and attributes blocking time to the
-engine call site — the "where do the round trips come from" view that
-jax.profiler traces don't give on a remote backend.
+
+Rebased on the flight recorder (spark_rapids_tpu/monitoring/): the sync
+funnels (jax.device_get, ArrayImpl.__array__/__int__/__float__/__bool__)
+are wrapped by monitoring/syncs.py, each blocking read records a ``sync``
+span with its engine call sites, and this script aggregates the span
+stream per site — so the sync attribution interleaves with the
+operator/upload/shuffle spans on the same timeline (trace_export shows
+each round trip INSIDE the operator that paid for it) instead of living
+in a private ad-hoc timer table.
 
 Usage: python scripts/syncprof.py [q1|q6|q3|q5|q67|xbb_q5|repart] [iters]
 Env: TPCH_SF (default 1.0), SYNCPROF_CPU=1 for the hermetic CPU backend.
 """
-import collections
 import os
 import sys
 import time
-import traceback
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -23,62 +26,29 @@ if os.environ.get("SYNCPROF_CPU"):
     _xb._backend_factories.pop("axon", None)
     jax.config.update("jax_platforms", "cpu")
 
-import jax  # noqa: E402
-
-_STATS = collections.defaultdict(lambda: [0, 0.0])   # site -> [count, secs]
-_ENABLED = [False]
+import jax  # noqa: E402,F401
 
 
-def _site() -> str:
-    """Innermost TWO spark_rapids_tpu frames (helper + its caller)."""
-    frames = []
-    for f in reversed(traceback.extract_stack()):
-        if "spark_rapids_tpu" in f.filename and "syncprof" not in f.filename:
-            short = f.filename.split("spark_rapids_tpu/")[-1]
-            frames.append(f"{short}:{f.lineno} {f.name}")
-            if len(frames) == 2:
-                break
-    return " <- ".join(frames) if frames else "<outside engine>"
-
-
-def _wrap(fn, label):
-    def wrapper(*a, **k):
-        if not _ENABLED[0]:
-            return fn(*a, **k)
-        t0 = time.perf_counter()
-        out = fn(*a, **k)
-        dt = time.perf_counter() - t0
-        s = _STATS[f"{label} @ {_site()}"]
-        s[0] += 1
-        s[1] += dt
-        return out
-    return wrapper
-
-
-def install():
-    from jax._src import array as _arr
-    jax.device_get = _wrap(jax.device_get, "device_get")
-    for m in ("__array__", "__int__", "__float__", "__bool__", "__index__"):
-        if hasattr(_arr.ArrayImpl, m):
-            setattr(_arr.ArrayImpl, m,
-                    _wrap(getattr(_arr.ArrayImpl, m), m))
-
-
-def report(wall: float):
-    total = sum(s[1] for s in _STATS.values())
-    n = sum(s[0] for s in _STATS.values())
+def report(wall: float, query_id=None):
+    from spark_rapids_tpu.monitoring.syncs import sync_stats
+    stats = sync_stats(query_id)
+    total = sum(secs for _, secs in stats.values())
+    n = sum(cnt for cnt, _ in stats.values())
     print(f"\n  syncs: {n} totalling {total:.3f}s "
           f"({100 * total / max(wall, 1e-9):.0f}% of wall)")
-    for site, (cnt, secs) in sorted(_STATS.items(), key=lambda kv: -kv[1][1]):
+    for site, (cnt, secs) in sorted(stats.items(), key=lambda kv: -kv[1][1]):
         print(f"  {secs:8.3f}s  x{cnt:<5d} {site}")
 
 
 def main():
     qn = sys.argv[1] if len(sys.argv) > 1 else "q3"
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 1
-    install()
+    from spark_rapids_tpu import monitoring
     from spark_rapids_tpu.api.dataframe import TpuSession
     from spark_rapids_tpu.benchmarks import suites, tpch
+    from spark_rapids_tpu.monitoring import syncs
+
+    syncs.install()
 
     sf = float(os.environ.get("TPCH_SF", "1.0"))
     if qn in tpch.QUERIES:
@@ -100,13 +70,15 @@ def main():
     df.collect()
     print(f"warmup: {time.perf_counter() - t0:.2f}s")
 
+    # Sync attribution needs the kernel level; the ring bound keeps even
+    # a sync-storm run to a bounded window.
+    session.set("spark.rapids.sql.trace.enabled", True)
+    session.set("spark.rapids.sql.trace.level", "kernel")
     for it in range(iters):
-        _STATS.clear()
-        _ENABLED[0] = True
+        monitoring.reset()
         t0 = time.perf_counter()
         rows = df.collect()
         wall = time.perf_counter() - t0
-        _ENABLED[0] = False
         print(f"\n=== {qn} iter {it}: wall {wall:.3f}s, {len(rows)} rows ===")
         report(wall)
 

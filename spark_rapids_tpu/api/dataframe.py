@@ -387,6 +387,30 @@ class DataFrame:
         print(report)
         return report
 
+    def explain_analyze(self) -> str:
+        """The plan tree annotated with OBSERVED per-operator
+        rows/bytes/wall-ms next to the cost model's per-node estimates
+        and the estimate error — the estimate-vs-actual feedback the
+        cost calibration needs (monitoring/analyze.py). Reads the LAST
+        collect() on this DataFrame; collects once if none ran yet."""
+        phys = self._physical()
+        if getattr(phys, "last_ctx", None) is None:
+            self.collect()
+        from spark_rapids_tpu.monitoring.analyze import render
+        report = render(phys, getattr(phys, "last_ctx", None))
+        print(report)
+        return report
+
+    def trace_export(self, path: Optional[str] = None) -> dict:
+        """Export the flight recorder's Chrome trace-event JSON (loads
+        in Perfetto / chrome://tracing): one track per query — this
+        DataFrame's last collect AND whatever ran concurrently — and
+        one per worker thread. Requires ``spark.rapids.sql.trace.enabled``
+        (or SRT_TRACE=1) during the collect; returns the trace document
+        and writes it to ``path`` when given."""
+        from spark_rapids_tpu import monitoring
+        return monitoring.export_chrome(path)
+
     def to_pandas(self):
         import pandas as pd
         rows = self.collect()
@@ -493,19 +517,18 @@ class DataFrame:
             return {}
         level = str(self._session.conf.get(C.METRICS_LEVEL)).upper()
         keep = self._METRIC_LEVELS.get(level)
-        # The Recovery@query entry (stageRecomputes, watchdogKills,
-        # meshDegrades, retriesAttempted...), the Pipeline@query entry
-        # (hostPrefetchMs, overlapRatio, pipelineStalls,
-        # concurrentStages...), the Scheduler@query entry (queuedMs,
-        # admitted, cancelled, deadlineKills, crossQueryEvictions...),
-        # the Transport@query entry (transportBytesWritten/Fetched,
-        # remoteShardRefetches...) and the Cost@query entry (placements,
-        # replanChecks, joinDemotions, estimateErrorPct...) are audit
-        # trails — never filtered by verbosity level.
+        # Audit-group entries (Recovery/Pipeline/Scheduler/Transport/
+        # Cost @query — stageRecomputes, overlapRatio, queuedMs,
+        # remoteShardRefetches, joinDemotions...) are audit trails,
+        # never filtered by verbosity level. The exemption set lives in
+        # ONE registry (ops/base.py audit_metric_groups) that every
+        # subsystem's query_metrics_entry() feeds — not in per-call-site
+        # tuples here.
+        from spark_rapids_tpu.ops.base import audit_metric_groups
+        exempt = audit_metric_groups()
         return {k: {name: v for name, v in m.values.items()
                     if keep is None or name in keep
-                    or m.owner in ("Recovery", "Pipeline", "Scheduler",
-                                   "Transport", "Cost")}
+                    or m.owner in exempt}
                 for k, m in ctx.metrics.items()}
 
     # -- writes ---------------------------------------------------------------

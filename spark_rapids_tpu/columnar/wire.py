@@ -808,7 +808,10 @@ def pack_batch(batch, capacity: Optional[int] = None,
                string_widths: Optional[dict] = None) -> EncodedBatch:
     """encode + pack: the complete host half of an upload (what pipeline
     prefetch threads stage ahead of the ordered consumer)."""
-    return pack_encoded(*encode_batch(batch, capacity, string_widths))
+    from spark_rapids_tpu import monitoring
+    with monitoring.span("wire-pack", "host-prefetch",
+                         level=monitoring.LEVEL_KERNEL):
+        return pack_encoded(*encode_batch(batch, capacity, string_widths))
 
 
 def _unpack_array(staged, off: int, name: str, shape, nbytes: int):
@@ -866,7 +869,10 @@ def upload_packed(enc: EncodedBatch) -> DeviceBatch:
         staged = jax.device_put(enc.staging)
         return _packed_jit(enc.cap, enc.specs)(staged)
 
-    out = retry_on_oom(put_and_decode)
+    from spark_rapids_tpu import monitoring
+    with monitoring.span("upload", "upload",
+                         args={"bytes": int(enc.nbytes), "rows": enc.n}):
+        out = retry_on_oom(put_and_decode)
     out.rows_hint = enc.n
     _wrecord("uploadTransfers")
     _wrecord("uploadedBatches")
@@ -891,7 +897,11 @@ def upload_packed_group(encs: Sequence[EncodedBatch]) -> List[DeviceBatch]:
         faults.fault_point("upload")
         return jax.device_put(combined)
 
-    staged_all = retry_on_oom(put_all)
+    from spark_rapids_tpu import monitoring
+    with monitoring.span("upload-group", "upload",
+                         args={"bytes": int(combined.nbytes),
+                               "batches": len(encs)}):
+        staged_all = retry_on_oom(put_all)
     _wrecord("uploadTransfers")
     _wrecord("uploadedBatches", len(encs))
     _wrecord("groupedUploads")

@@ -429,7 +429,35 @@ RESERVE_BYTES = conf("spark.rapids.memory.tpu.reserve").doc(
 METRICS_LEVEL = conf("spark.rapids.sql.metrics.level").doc(
     "Operator metric verbosity reported by DataFrame.metrics(): "
     "ESSENTIAL (rows/time), MODERATE (+batches/shuffle), or DEBUG "
-    "(everything the execs record).").string("DEBUG")
+    "(everything the execs record). Audit groups registered in "
+    "ops/base.py (Recovery/Pipeline/Scheduler/Transport/Cost @query) "
+    "are never filtered.").string("DEBUG")
+
+TRACE_ENABLED = conf("spark.rapids.sql.trace.enabled").doc(
+    "Query flight recorder (spark_rapids_tpu/monitoring/): record "
+    "structured trace spans (scheduler queue, host prefetch, wire "
+    "pack/upload, per-operator device dispatch, shuffle write/fetch, "
+    "stage materialization) and instant events (fault injected, OOM "
+    "rung, stage recompute, join demotion, watchdog kill, "
+    "cancellation, cross-query eviction) into a bounded per-query "
+    "ring buffer. Consumed by DataFrame.trace_export (Chrome/Perfetto "
+    "JSON), DataFrame.explain_analyze, monitoring.snapshot() and "
+    "bench.py's trace block. Off = a no-op recorder with near-zero "
+    "per-call overhead (the NVTX-always-on analog, "
+    "NvtxWithMetrics.scala:21-44). The SRT_TRACE env (0/1) overrides "
+    "the default for a whole process.").boolean(False)
+
+TRACE_MAX_EVENTS = conf("spark.rapids.sql.trace.maxEvents").doc(
+    "Per-query ring-buffer bound for the flight recorder: once a "
+    "query's ring is full the oldest events drop (droppedEvents in "
+    "monitoring.snapshot() counts them), so tracing can stay on under "
+    "sustained load without unbounded memory.").integer(65536)
+
+TRACE_LEVEL = conf("spark.rapids.sql.trace.level").doc(
+    "Flight-recorder verbosity: 'query' (query/stage lifecycle spans + "
+    "every instant event), 'operator' (+ per-partition, per-operator, "
+    "upload, shuffle spans), or 'kernel' (+ per-batch wire encode/pack "
+    "and host-sync attribution spans).").string("operator")
 
 HOST_SPILL_STORAGE_SIZE = conf("spark.rapids.memory.host.spillStorageSize").doc(
     "Bytes of host RAM for spilled device batches before going to disk."
@@ -976,6 +1004,27 @@ def generate_docs() -> str:
         "and `aqe.coalescePartitions.targetBytes` hold. Decisions and",
         "estimate-vs-actual error surface in the `Cost@query` metrics",
         "entry and bench.py's `cost` JSON block. See docs/performance.md.",
+        "",
+        "## Query flight recorder",
+        "",
+        "With `spark.rapids.sql.trace.enabled` (or `SRT_TRACE=1`) every",
+        "execution funnel records structured spans — scheduler admission",
+        "queue, TPU-semaphore acquire, host prefetch, wire pack, upload,",
+        "per-operator device dispatch, shuffle materialize/serve, stage",
+        "prematerialization, result download — and instant events (fault",
+        "injected, OOM rung, stage recompute, join demotion, watchdog",
+        "kill, cancellation, cross-query eviction) into a bounded",
+        "per-query ring buffer (`trace.maxEvents`; `trace.level` picks",
+        "query < operator < kernel verbosity). Consumers:",
+        "`DataFrame.trace_export(path)` writes Chrome trace-event JSON",
+        "(Perfetto / chrome://tracing, one track per query and worker",
+        "thread), `DataFrame.explain_analyze()` renders the plan tree",
+        "with observed rows/bytes/wall next to the cost model's",
+        "estimates, `monitoring.snapshot()` aggregates the span-category",
+        "breakdown bench.py publishes as its `trace` JSON block.",
+        "Disabled, the recorder is a shared no-op costing nanoseconds",
+        "per call site — results and metrics are byte-identical either",
+        "way. See docs/observability.md.",
         "",
         "## Dynamic per-rule kill switches",
         "",

@@ -517,6 +517,11 @@ def check_fault(site: str, kinds) -> Optional[FaultSpec]:
         return None
     record("faultsInjected")
     record(f"faultsInjected.{e.kind}@{site}")
+    # Flight-recorder instant (lazy import: this module stays
+    # stdlib-only at load; monitoring is itself stdlib-only).
+    from spark_rapids_tpu import monitoring
+    monitoring.instant("fault-injected", "recovery",
+                       args={"kind": e.kind, "site": site})
     return e
 
 
@@ -558,6 +563,9 @@ def corrupt_blob(site: str, blob: bytes) -> bytes:
         return blob
     record("faultsInjected")
     record(f"faultsInjected.corrupt@{site}")
+    from spark_rapids_tpu import monitoring
+    monitoring.instant("fault-injected", "recovery",
+                       args={"kind": "corrupt", "site": site})
     off = inj._rng(site).randrange(len(blob))
     out = bytearray(blob)
     out[off] ^= 0xFF

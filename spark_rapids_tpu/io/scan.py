@@ -41,7 +41,7 @@ from spark_rapids_tpu import config as C
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.host import HostBatch, host_to_device
 from spark_rapids_tpu.ops.base import Exec, ExecContext, LeafExec, Schema, \
-    timed
+    record_batch, timed
 from spark_rapids_tpu.io.arrow_convert import (
     arrow_to_host_batch, schema_from_arrow)
 
@@ -531,7 +531,7 @@ class FileScanExec(LeafExec):
                         self._publish_input_file(ctx, partition,
                                                  unit.path)
                 entry_batches[ei].append(b)
-                m.add("numOutputBatches", 1)
+                record_batch(m, b)
                 yield b
                 last_of_entry = i + 1 >= len(flat) or \
                     flat[i + 1][0] != ei
@@ -561,7 +561,7 @@ class FileScanExec(LeafExec):
                     m.add("scanCacheHits", 1)
                     self._publish_input_file(ctx, partition, unit.path)
                     for b in hit:
-                        m.add("numOutputBatches", 1)
+                        record_batch(m, b)
                         yield b
                 else:
                     # Evicted between prefetch and consume: decode inline.
@@ -630,7 +630,7 @@ class FileScanExec(LeafExec):
             m.add("scanCacheHits", 1)
             self._publish_input_file(ctx, partition, unit.path)
             for b in hit:
-                m.add("numOutputBatches", 1)
+                record_batch(m, b)
                 yield b
         if run:
             yield from read(ctx, m, run, rows, partition, budget)
@@ -645,7 +645,7 @@ class FileScanExec(LeafExec):
                                          rows, self._columns):
                 with timed(m, "bufferTime"):
                     batch = host_to_device(hb)
-                m.add("numOutputBatches", 1)
+                record_batch(m, batch)
                 ubatches.append(batch)
                 yield batch
             if budget > 0:
@@ -732,7 +732,7 @@ class FileScanExec(LeafExec):
         merged = concat_host_batches(hbs)
         with timed(m, "bufferTime"):
             batch = host_to_device(merged)
-        m.add("numOutputBatches", 1)
+        record_batch(m, batch)
         return batch
 
 

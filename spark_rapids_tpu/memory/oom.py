@@ -196,6 +196,8 @@ def retry_on_oom(fn: Callable[..., T], *args, **kwargs) -> T:
         rungs.append(rung)
         last_ladder[:] = rungs
         faults.record("spillEscalations")
+        from spark_rapids_tpu import monitoring
+        monitoring.instant("oom-rung", "recovery", args={"rung": rung})
         _LOG.warning("device OOM: escalation rung %r (of %r), retrying "
                      "dispatch: %s", rung, rungs, last)
         try:

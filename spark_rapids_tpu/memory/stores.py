@@ -539,15 +539,20 @@ class TpuSemaphore:
         # Cancellation-aware: a query cancelled/deadlined while QUEUED
         # for the device must unwind instead of eventually grabbing a
         # permit it will never use (its neighbors keep the device busy).
-        from spark_rapids_tpu import faults
-        tok = faults.get_query_token()
-        if tok is None:
-            self._sem.acquire()
+        # The acquire records as a "queued" span — device-semaphore
+        # contention is one of the three queueing stories the flight
+        # recorder separates (admission queue, semaphore, pipeline wait).
+        from spark_rapids_tpu import faults, monitoring
+        with monitoring.span("tpu-semaphore-acquire", "queued",
+                             level=monitoring.LEVEL_QUERY):
+            tok = faults.get_query_token()
+            if tok is None:
+                self._sem.acquire()
+                return self
+            while not self._sem.acquire(timeout=0.05):
+                if tok.cancelled():
+                    raise tok.error()
             return self
-        while not self._sem.acquire(timeout=0.05):
-            if tok.cancelled():
-                raise tok.error()
-        return self
 
     def __exit__(self, *exc):
         self._sem.release()

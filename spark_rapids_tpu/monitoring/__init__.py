@@ -1,0 +1,19 @@
+"""Query flight recorder + trace consumers (the observability substrate).
+
+- :mod:`recorder` — bounded per-query ring buffers of spans/instants,
+  with a near-zero disabled path (``spark.rapids.sql.trace.*``).
+- :mod:`chrome` — Chrome trace-event JSON (Perfetto / chrome://tracing).
+- :mod:`analyze` — the ``explain_analyze`` renderer (observed metrics
+  next to cost-model estimates).
+- :mod:`syncs` — host-sync funnel attribution on the same span stream.
+
+Import cost matters: this package (like faults.py) is imported from
+deep dispatch code, so the recorder stays stdlib-only and everything
+engine-shaped is lazy.
+"""
+
+from spark_rapids_tpu.monitoring.recorder import (     # noqa: F401
+    LEVEL_KERNEL, LEVEL_OPERATOR, LEVEL_QUERY, category_breakdown,
+    configure, enabled, events, export_chrome, instant, level,
+    maybe_configure, now_ns, open_span_count, query_ids, record_span,
+    reset, snapshot, span, thread_names, trace_enabled)

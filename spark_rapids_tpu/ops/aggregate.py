@@ -39,7 +39,8 @@ from spark_rapids_tpu.columnar.batch import (
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.exprs.base import (
     Expression, as_device_column, as_host_column)
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 from spark_rapids_tpu.ops import kernels
 
 
@@ -1165,7 +1166,7 @@ class HashAggregateExec(Exec):
                     # Partial stage feeds an exchange, which batches its
                     # own sizes pull across every partition — emit the
                     # per-batch partial as-is, no sync here.
-                    m.add("numOutputBatches", 1)
+                    record_batch(m, partial)
                     yield partial
                     continue
                 pending.append(partial)
@@ -1193,7 +1194,7 @@ class HashAggregateExec(Exec):
             return
         with timed(m):
             acc = self._consolidate(ctx, m, pending, final_stage=True)
-        m.add("numOutputBatches", 1)
+        record_batch(m, acc)
         yield acc
 
     def _empty_result(self) -> DeviceBatch:

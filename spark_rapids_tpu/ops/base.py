@@ -52,6 +52,69 @@ class Metrics:
         return f"Metrics({self.values})"
 
 
+# -- audit metric groups ------------------------------------------------------
+# THE registry of per-query audit entries (<Owner>@query) that the
+# metrics verbosity filter (spark.rapids.sql.metrics.level) must never
+# drop: they are recovery/scheduling audit trails, not operator
+# telemetry. Every subsystem creates its entry through
+# query_metrics_entry(), which registers the owner here — replacing the
+# ad-hoc per-call-site exemptions DataFrame.metrics() used to hardcode.
+_AUDIT_METRIC_GROUPS = {"Recovery", "Pipeline", "Scheduler", "Transport",
+                        "Cost"}
+_AUDIT_LOCK = threading.Lock()
+
+
+def register_audit_metric_group(owner: str) -> None:
+    """Mark ``owner`` as a level-filter-exempt audit group (idempotent).
+    Third-party subsystems get the same never-filtered treatment as the
+    built-in Recovery/Pipeline/Scheduler/Transport/Cost entries."""
+    with _AUDIT_LOCK:
+        _AUDIT_METRIC_GROUPS.add(owner)
+
+
+def audit_metric_groups() -> frozenset:
+    with _AUDIT_LOCK:
+        return frozenset(_AUDIT_METRIC_GROUPS)
+
+
+def query_metrics_entry(ctx: "ExecContext", owner: str) -> Metrics:
+    """The per-query ``<owner>@query`` audit Metrics entry, created on
+    first use and registered as level-filter exempt. All subsystems
+    (scheduler, pipeline, transport, cost/replan, recovery) route
+    through here so the exemption set has exactly one source."""
+    register_audit_metric_group(owner)
+    return ctx.metrics.setdefault(f"{owner}@query", Metrics(owner=owner))
+
+
+def record_batch(m: Metrics, batch) -> None:
+    """Record one output batch's observable size: always
+    ``numOutputBatches``; ``numOutputRows``/``numOutputBytes`` when a
+    HOST-KNOWN row count exists (``rows_hint`` on device batches, exact
+    ``num_rows`` on host batches). Never forces a device sync — an
+    unknown count stays unknown (explain_analyze renders ``?``) rather
+    than costing a ~70ms round trip per batch."""
+    m.add("numOutputBatches", 1)
+    rows = getattr(batch, "rows_hint", None)
+    if rows is None:
+        nr = getattr(batch, "num_rows", None)
+        if type(nr) is int:
+            rows = nr
+    if rows is None:
+        return
+    m.add("numOutputRows", int(rows))
+    try:
+        width = 0
+        for c in batch.columns:
+            if c.dtype.is_string:
+                width += int(c.data.shape[1]) + 5
+            else:
+                width += int(c.dtype.np_dtype.itemsize) + 1
+        if width:
+            m.add("numOutputBytes", int(rows) * width)
+    except Exception:
+        pass        # exotic column layout: rows recorded, bytes skipped
+
+
 @dataclasses.dataclass
 class ExecContext:
     """Per-query execution context: conf + metrics sink + materialization
@@ -301,6 +364,7 @@ class Exec:
         except StopIteration:
             return
         except OomRetryExhausted as e:
+            from spark_rapids_tpu import monitoring
             grace_it = self._grace_retry(ctx, partition)
             if grace_it is not None:
                 import logging
@@ -308,6 +372,9 @@ class Exec:
                     "OOM ladder exhausted in %s partition %d; retrying "
                     "on-device via the grace-partitioned path: %s",
                     self.name, partition, e)
+                monitoring.instant(
+                    "grace-join-engaged", "recovery",
+                    args={"op": self.name, "partition": partition})
                 try:
                     first = next(grace_it)
                 except StopIteration:
@@ -331,6 +398,9 @@ class Exec:
                 self.name, partition, e)
             faults.record("hostFallbacks")
             ctx.metrics_for(self).add("hostFallbacks", 1)
+            monitoring.instant(
+                "host-fallback", "recovery",
+                args={"op": self.name, "partition": partition})
             for hb in host_iter:
                 yield host_to_device(hb)
             return
@@ -394,6 +464,11 @@ class Exec:
             cancel.set()
             faults.record("watchdogKills")
             ctx.metrics_for(self).add("watchdogKills", 1)
+            from spark_rapids_tpu import monitoring
+            monitoring.instant(
+                "watchdog-kill", "recovery",
+                args={"op": self.name, "label": label,
+                      "attempt": attempt + 1})
             import logging
             logging.getLogger("spark_rapids_tpu").warning(
                 "watchdog: %s %s exceeded %dms (attempt %d/%d)"
@@ -416,10 +491,7 @@ class Exec:
         """The per-query Recovery metrics entry (retriesAttempted /
         spillEscalations / hostFallbacks / faultsInjected...), surfaced
         by DataFrame.metrics() next to the per-operator entries."""
-        m = ctx.metrics.get("Recovery@query")
-        if m is None:
-            m = ctx.metrics["Recovery@query"] = Metrics(owner="Recovery")
-        return m
+        return query_metrics_entry(ctx, "Recovery")
 
     def collect(self, ctx: Optional[ExecContext] = None,
                 device: bool = True) -> List[tuple]:
@@ -436,97 +508,132 @@ class Exec:
         rows: List[tuple] = []
         names = tuple(n for n, _ in self.schema)
         if device:
-            from spark_rapids_tpu import config as C
+            from spark_rapids_tpu import config as C, monitoring
             from spark_rapids_tpu.columnar import wire
             from spark_rapids_tpu.columnar.host import download_batches
             from spark_rapids_tpu.memory.stores import get_tpu_semaphore
             # Adopt this query's wire codec selection (process-global,
-            # spark.rapids.sql.wire.codec) before any upload happens.
+            # spark.rapids.sql.wire.codec) before any upload happens —
+            # and its flight-recorder configuration, before any span
+            # site runs (spark.rapids.sql.trace.*).
             wire.maybe_configure(ctx.conf)
+            monitoring.maybe_configure(ctx.conf)
             # Task admission (GpuSemaphore.scala:74-87): at most
             # concurrentTpuTasks collects issue device work at once, so
             # concurrent queries can't oversubscribe HBM.
             sem = get_tpu_semaphore(
                 max(int(ctx.conf.get(C.CONCURRENT_TPU_TASKS)), 1))
-            with sem:
-                # OOM->spill->retry needs the catalog reachable from
-                # dispatch sites deep in the kernel layer (memory/oom.py);
-                # the recovery sink mirrors ladder/fallback/injection
-                # counters into this query's Metrics.
-                from spark_rapids_tpu import faults
-                from spark_rapids_tpu.memory.oom import set_active_catalog
-                set_active_catalog(ctx.catalog)
-                faults.set_recovery_sink(self._recovery_metrics(ctx))
-                try:
-                    from spark_rapids_tpu.parallel import pipeline as PL
-                    from spark_rapids_tpu.parallel import replan as RP
-                    # Runtime adaptive re-planning BEFORE stage
-                    # prematerialization: build-side exchanges
-                    # materialize now, observed sizes demote shuffled
-                    # joins to broadcast, and the skipped probe
-                    # exchanges are flagged so the stage pass does not
-                    # shuffle them anyway (parallel/replan.py).
-                    RP.plan_adaptive(ctx, self)
-                    # Independent stages (join build/probe sides...)
-                    # materialize their exchange outputs concurrently
-                    # before the ordered partition loop; a no-op when
-                    # the pipeline is off or the plan is single-stage.
-                    PL.prematerialize_stages(ctx, self)
-                    wd = _watchdog_params(ctx.conf)
-                    batches: List[DeviceBatch] = []
-                    if wd is None:
-                        nparts = self.num_partitions(ctx)
-                        pipe = PL.open_pipeline(ctx, self, nparts)
-                        try:
-                            for p in range(nparts):
-                                # Per-partition cancellation checkpoint
-                                # (the deep funnels check too, via
-                                # fault_point).
-                                faults.check_cancelled()
-                                # consume() waits for p's host half then
-                                # returns the device stream verbatim, so
-                                # the serial path keeps streaming exactly
-                                # as before.
-                                batches.extend(pipe.consume(
-                                    p, lambda p=p:
-                                    self.execute_device_recovering(
-                                        ctx, p)))
-                        finally:
-                            pipe.close()
-                    else:
-                        # The partition count itself can trigger device
-                        # work (AQE coalescing materializes the exchange
-                        # to learn exact bucket sizes), so it runs under
-                        # the watchdog too; the pipeline's per-partition
-                        # wait then happens INSIDE the watchdog deadline
-                        # (a stalled prefetch is killed with the attempt).
-                        nparts = self._watchdog_run(
-                            ctx, wd, "partition-count",
-                            lambda: self.num_partitions(ctx))
-                        pipe = PL.open_pipeline(ctx, self, nparts)
-                        try:
-                            for p in range(nparts):
-                                batches.extend(self._watchdog_run(
-                                    ctx, wd, f"partition {p}",
-                                    lambda p=p: pipe.consume(
-                                        p, lambda: list(
+            # The query-level span covers EVERYTHING the device path
+            # pays for: semaphore wait, adaptive re-planning, stage
+            # prematerialization, the partition loop, and the download.
+            collect_span = monitoring.span(
+                "collect", "query", level=monitoring.LEVEL_QUERY,
+                args={"op": self.name})
+            collect_span.__enter__()
+            try:
+                with sem:
+                    # OOM->spill->retry needs the catalog reachable from
+                    # dispatch sites deep in the kernel layer (memory/oom.py);
+                    # the recovery sink mirrors ladder/fallback/injection
+                    # counters into this query's Metrics.
+                    from spark_rapids_tpu import faults
+                    from spark_rapids_tpu.memory.oom import set_active_catalog
+                    set_active_catalog(ctx.catalog)
+                    faults.set_recovery_sink(self._recovery_metrics(ctx))
+                    try:
+                        from spark_rapids_tpu.parallel import pipeline as PL
+                        from spark_rapids_tpu.parallel import replan as RP
+                        # Runtime adaptive re-planning BEFORE stage
+                        # prematerialization: build-side exchanges
+                        # materialize now, observed sizes demote shuffled
+                        # joins to broadcast, and the skipped probe
+                        # exchanges are flagged so the stage pass does not
+                        # shuffle them anyway (parallel/replan.py).
+                        RP.plan_adaptive(ctx, self)
+                        # Independent stages (join build/probe sides...)
+                        # materialize their exchange outputs concurrently
+                        # before the ordered partition loop; a no-op when
+                        # the pipeline is off or the plan is single-stage.
+                        PL.prematerialize_stages(ctx, self)
+                        wd = _watchdog_params(ctx.conf)
+                        batches: List[DeviceBatch] = []
+                        if wd is None:
+                            nparts = self.num_partitions(ctx)
+                            pipe = PL.open_pipeline(ctx, self, nparts)
+                            try:
+                                for p in range(nparts):
+                                    # Per-partition cancellation
+                                    # checkpoint (the deep funnels check
+                                    # too, via fault_point).
+                                    faults.check_cancelled()
+                                    # consume() waits for p's host half
+                                    # then returns the device stream
+                                    # verbatim, so the serial path keeps
+                                    # streaming exactly as before.
+                                    with monitoring.span(
+                                            "partition", "device-compute",
+                                            args={"partition": p,
+                                                  "op": self.name}):
+                                        batches.extend(pipe.consume(
+                                            p, lambda p=p:
                                             self.execute_device_recovering(
-                                                ctx, p)))))
-                        finally:
-                            pipe.close()
-                    host_batches = download_batches(batches, names)
-                finally:
-                    set_active_catalog(None)
-                    faults.set_recovery_sink(None)
-            # Row materialization is pure host CPU — outside the permit,
-            # like the reference releasing GpuSemaphore once the task
-            # leaves the device.
-            for hb in host_batches:
-                rows.extend(hb.to_pylist())
+                                                ctx, p)))
+                            finally:
+                                pipe.close()
+                        else:
+                            # The partition count itself can trigger
+                            # device work (AQE coalescing materializes
+                            # the exchange to learn exact bucket sizes),
+                            # so it runs under the watchdog too; the
+                            # pipeline's per-partition wait then happens
+                            # INSIDE the watchdog deadline (a stalled
+                            # prefetch is killed with the attempt).
+                            nparts = self._watchdog_run(
+                                ctx, wd, "partition-count",
+                                lambda: self.num_partitions(ctx))
+                            pipe = PL.open_pipeline(ctx, self, nparts)
+                            try:
+                                for p in range(nparts):
+                                    with monitoring.span(
+                                            "partition", "device-compute",
+                                            args={"partition": p,
+                                                  "op": self.name}):
+                                        batches.extend(self._watchdog_run(
+                                            ctx, wd, f"partition {p}",
+                                            lambda p=p: pipe.consume(
+                                                p, lambda: list(
+                                                    self
+                                                    .execute_device_recovering(
+                                                        ctx, p)))))
+                            finally:
+                                pipe.close()
+                        with monitoring.span(
+                                "download", "device-compute",
+                                args={"batches": len(batches)}):
+                            host_batches = download_batches(batches, names)
+                    finally:
+                        set_active_catalog(None)
+                        faults.set_recovery_sink(None)
+                # Row materialization is pure host CPU — outside the permit,
+                # like the reference releasing GpuSemaphore once the task
+                # leaves the device.
+                for hb in host_batches:
+                    rows.extend(hb.to_pylist())
+            finally:
+                collect_span.__exit__(None, None, None)
         else:
-            for p in range(self.num_partitions(ctx)):
-                for b in self.execute_host(ctx, p):
-                    rows.extend(b.to_pylist())
+            from spark_rapids_tpu import monitoring
+            monitoring.maybe_configure(ctx.conf)
+            with monitoring.span("collect", "query",
+                                 level=monitoring.LEVEL_QUERY,
+                                 args={"op": self.name,
+                                       "engine": "host"}):
+                for p in range(self.num_partitions(ctx)):
+                    with monitoring.span("partition", "host-compute",
+                                         args={"partition": p,
+                                               "op": self.name}):
+                        for b in self.execute_host(ctx, p):
+                            rows.extend(b.to_pylist())
         return rows
 
     def pretty_tree(self, indent: int = 0) -> str:
@@ -606,22 +713,38 @@ class HostToDeviceExec(Exec):
         raise AssertionError("HostToDeviceExec is a device-side node")
 
 
+# Flight-recorder category per timed() metric: operator dispatch is
+# device-compute; scan decode/buffer work is host-side; shuffle and
+# sizes-pull syncs label themselves.
+_TIMED_CATS = {"bufferTime": "host-prefetch", "shuffleTime": "shuffle",
+               "sizesPullTime": "sync"}
+
+
 def timed(metrics: Metrics, name: str = "totalTime"):
     """Context manager adding elapsed ns to a metric AND opening a
     ``jax.profiler.TraceAnnotation`` named ``<Op>:<metric>`` — a captured
     profile (jax.profiler.trace) shows every operator's dispatch ranges
-    (NvtxWithMetrics.scala:21-44 analog)."""
+    (NvtxWithMetrics.scala:21-44 analog). The same interval records as a
+    flight-recorder span (monitoring/recorder.py), so every operator
+    that meters itself lands on the trace timeline for free."""
     import jax.profiler as _prof
+    from spark_rapids_tpu.monitoring import recorder as _rec
 
     class _Timer:
         def __enter__(self):
             self._ann = _prof.TraceAnnotation(
                 f"{metrics.owner or 'op'}:{name}")
             self._ann.__enter__()
+            self._span = _rec.span(
+                metrics.owner or "op", _TIMED_CATS.get(
+                    name, "device-compute"), _rec.LEVEL_OPERATOR,
+                args=None if name == "totalTime" else {"metric": name})
+            self._span.__enter__()
             self.t0 = time.perf_counter_ns()
 
         def __exit__(self, *exc):
             metrics.add(name, time.perf_counter_ns() - self.t0)
+            self._span.__exit__(None, None, None)
             self._ann.__exit__(None, None, None)
             return False
     return _Timer()

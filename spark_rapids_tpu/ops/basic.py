@@ -24,7 +24,8 @@ from spark_rapids_tpu.exprs.base import (
 from spark_rapids_tpu.exprs.nondeterministic import (
     EvalContext, eval_context, needs_eval_context)
 from spark_rapids_tpu.ops import kernel_cache as kc
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 
 
 def _input_file_key(op: Exec, partition: int, host: bool = False
@@ -86,7 +87,7 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
                 (fp, schema_fp, batch.capacity), build, m)
             with timed(m):
                 out, base = kc.call(entry, m, batch, pid, base)
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
     else:
         base = 0
@@ -97,7 +98,7 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
             with timed(m), eval_context(ec):
                 out = kernel(batch)
             base = base + batch.num_rows.astype(jnp.int64)
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
 
 
@@ -152,7 +153,7 @@ class ProjectExec(Exec):
             # Projection preserves row count — keep the host-known hint so
             # downstream size consumers skip their device sync.
             out.rows_hint = batch.rows_hint
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
 
     def execute_host(self, ctx, partition):
@@ -219,7 +220,7 @@ class FilterExec(Exec):
             else:
                 with timed(m):
                     out = kernel(batch)
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
 
     def execute_host(self, ctx, partition):

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.exprs.base import as_device_column, eval_exprs
 from spark_rapids_tpu.ops import kernel_cache as kc
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 
 
 def _stage_specs(ops: Sequence[Exec]) -> List[Tuple[str, object]]:
@@ -139,7 +140,7 @@ class FusedStageExec(Exec):
                     # keep the host-known hint so downstream size
                     # consumers skip their device sync.
                     out.rows_hint = batch.rows_hint
-                m.add("numOutputBatches", 1)
+                record_batch(m, out)
                 yield out
 
     def execute_host(self, ctx: ExecContext, partition: int):

@@ -26,7 +26,8 @@ from spark_rapids_tpu.columnar.batch import (
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
     as_host_column
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 
 
 class GenerateExec(Exec):
@@ -135,7 +136,7 @@ class GenerateExec(Exec):
                 lambda: jax.jit(kc.detached_clone(self)._kernel), m)
             with timed(m):
                 out = kc.call(entry, m, batch)
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
 
     # -- host oracle ---------------------------------------------------------

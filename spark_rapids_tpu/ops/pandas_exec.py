@@ -25,7 +25,8 @@ import numpy as np
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.host import (
     HostBatch, HostColumn, device_to_host, host_to_device)
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 
 _POOLS: dict = {}
 
@@ -187,7 +188,7 @@ class MapInPandasExec(_PandasIslandExec):
 
         with timed(m):
             for hb in self._run(frames()):
-                m.add("numOutputBatches", 1)
+                record_batch(m, hb)
                 yield self._upload(hb)
 
     def execute_host(self, ctx, partition):
@@ -227,7 +228,7 @@ class FlatMapGroupsInPandasExec(_PandasIslandExec):
         with timed(m):
             hb = self._apply(ctx, self._child_pdf(ctx, partition))
         if hb is not None and hb.num_rows:
-            m.add("numOutputBatches", 1)
+            record_batch(m, hb)
             yield self._upload(hb)
 
     def execute_host(self, ctx, partition):
@@ -284,7 +285,7 @@ class CoGroupedMapInPandasExec(_PandasIslandExec):
             hb = self._apply(ctx, self._child_pdf(ctx, partition, 0),
                              self._child_pdf(ctx, partition, 1))
         if hb is not None and hb.num_rows:
-            m.add("numOutputBatches", 1)
+            record_batch(m, hb)
             yield self._upload(hb)
 
     def execute_host(self, ctx, partition):
@@ -343,7 +344,7 @@ class AggregateInPandasExec(_PandasIslandExec):
         with timed(m):
             hb = self._apply(ctx, self._child_pdf(ctx, partition))
         if hb is not None and hb.num_rows:
-            m.add("numOutputBatches", 1)
+            record_batch(m, hb)
             yield self._upload(hb)
 
     def execute_host(self, ctx, partition):

@@ -22,7 +22,8 @@ from spark_rapids_tpu.columnar.batch import (
 from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
     as_host_column
-from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
+from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
+    record_batch, timed)
 from spark_rapids_tpu.ops import kernels
 
 
@@ -146,7 +147,7 @@ def out_of_core_partition(ctx, metrics, child_iter, schema,
             sb.close()
         with timed(m):
             out = retry_on_oom(batch_fn, single)
-        m.add("numOutputBatches", 1)
+        record_batch(m, out)
         yield out
         return
     nb = max(2, -(-total_bytes // bucket_budget))
@@ -161,7 +162,7 @@ def out_of_core_partition(ctx, metrics, child_iter, schema,
             with timed(m):
                 out = retry_on_oom(batch_fn,
                                    coalesce_to_single_batch(bucket))
-            m.add("numOutputBatches", 1)
+            record_batch(m, out)
             yield out
     finally:
         for sb in spillables:

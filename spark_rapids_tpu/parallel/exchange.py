@@ -262,6 +262,14 @@ class ShuffleExchangeExec(Exec):
         key = self._cache_key(True)
         if key in ctx.cache:
             return ctx.cache[key]
+        from spark_rapids_tpu import monitoring
+        with monitoring.span("exchange-materialize", "shuffle",
+                             args={"op": self.name,
+                                   "partitions":
+                                   self.partitioning.num_partitions}):
+            return self._materialize_device_traced(ctx, key)
+
+    def _materialize_device_traced(self, ctx, key):
         self._ensure_bounds(ctx, device=True)
         n = self.partitioning.num_partitions
         sess = self._open_session(ctx)
@@ -438,11 +446,14 @@ class ShuffleExchangeExec(Exec):
             return out, []
 
         def serve(sbs):
-            from spark_rapids_tpu import faults
+            from spark_rapids_tpu import faults, monitoring
             from spark_rapids_tpu.columnar.wire import WireCorruptionError
             faults.fault_point("exchange.serve", owner=id(self))
             try:
-                out, pending = flush(sbs)
+                with monitoring.span("exchange-serve", "shuffle",
+                                     args={"partition": partition,
+                                           "shards": len(sbs)}):
+                    out, pending = flush(sbs)
             except WireCorruptionError as err:
                 # A durable stage output failed its CRC even after the
                 # re-read: the data at rest is gone. Tag the loss with
@@ -458,11 +469,16 @@ class ShuffleExchangeExec(Exec):
                 for sb in pending:
                     sb.release(PRIORITY_SHUFFLE_OUTPUT)
 
+        from spark_rapids_tpu import monitoring
         groups = self._groups(ctx)
         mine = groups[partition] if groups is not None else [partition]
         try:
             for b in mine:
-              for sb in sess.fetch_shards(b):
+              with monitoring.span("fetch-shards", "shuffle",
+                                   level=monitoring.LEVEL_KERNEL,
+                                   args={"bucket": b}):
+                  fetched = sess.fetch_shards(b)
+              for sb in fetched:
                 if group and group_cap + sb.capacity > target:
                     yield from serve(group)
                     group, group_cap = [], 0
@@ -551,15 +567,19 @@ class BroadcastExchangeExec(Exec):
             batch = handle.get()
             handle.release(PRIORITY_BROADCAST)
             return batch
+        from spark_rapids_tpu import monitoring
         from spark_rapids_tpu.parallel import pipeline as PL
         nchild = self.children[0].num_partitions(ctx)
         pipe = PL.open_pipeline(ctx, self.children[0], nchild)
         batches = []
         try:
-            for cp in range(nchild):
-                batches.extend(pipe.consume(
-                    cp, lambda cp=cp:
-                    self.children[0].execute_device_recovering(ctx, cp)))
+            with monitoring.span("broadcast-collect", "shuffle",
+                                 args={"partitions": nchild}):
+                for cp in range(nchild):
+                    batches.extend(pipe.consume(
+                        cp, lambda cp=cp:
+                        self.children[0].execute_device_recovering(ctx,
+                                                                   cp)))
         finally:
             pipe.close()
         if not batches:

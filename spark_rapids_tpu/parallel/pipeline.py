@@ -107,10 +107,11 @@ def _with_overlap_ratio(vals: Dict[str, float]) -> Dict[str, float]:
 
 
 def metrics_entry(ctx):
-    """The per-query Pipeline metrics entry (next to Recovery@query)."""
-    from spark_rapids_tpu.ops.base import Metrics
-    return ctx.metrics.setdefault("Pipeline@query",
-                                  Metrics(owner="Pipeline"))
+    """The per-query Pipeline metrics entry (next to Recovery@query;
+    registered level-filter exempt through the ops/base.py audit
+    registry)."""
+    from spark_rapids_tpu.ops.base import query_metrics_entry
+    return query_metrics_entry(ctx, "Pipeline")
 
 
 def finalize_metrics(ctx) -> None:
@@ -203,14 +204,16 @@ class PartitionPipeline:
 
     # -- producers -----------------------------------------------------------
     def _prefetch_task(self, partition: int, cancel) -> None:
-        from spark_rapids_tpu import faults
+        from spark_rapids_tpu import faults, monitoring
         faults.set_recovery_sink(self._sink)
         faults.set_query_token(self._token)
         faults.set_cancel_event(cancel)
         t0 = time.perf_counter()
         try:
             if not cancel.is_set():
-                self._source.prefetch_host(self._ctx, partition)
+                with monitoring.span("prefetch", "host-prefetch",
+                                     args={"partition": partition}):
+                    self._source.prefetch_host(self._ctx, partition)
         finally:
             faults.set_cancel_event(None)
             faults.set_query_token(None)
@@ -241,8 +244,15 @@ class PartitionPipeline:
             return                      # re-dispatch after a kill: inline
         slot.consumed = True
         fut = slot.future
+        wait_span = None
         if not fut.done():
             _record(self._ctx, "pipelineStalls", 1)
+            # The ordered consumer actually blocked on this partition's
+            # host half: that wait is queue time, on the trace timeline.
+            from spark_rapids_tpu import monitoring
+            wait_span = monitoring.span("pipeline-wait", "queued",
+                                        args={"partition": partition})
+            wait_span.__enter__()
         t0 = time.perf_counter()
         try:
             while True:
@@ -277,6 +287,8 @@ class PartitionPipeline:
                 return
             raise
         finally:
+            if wait_span is not None:
+                wait_span.__exit__(None, None, None)
             waited = (time.perf_counter() - t0) * 1000.0
             if waited > 0:
                 _record(self._ctx, "consumerWaitMs", waited)
@@ -352,13 +364,17 @@ def prematerialize_stages(ctx, root) -> None:
     token = faults.get_query_token()
 
     def run_stage(st):
+        from spark_rapids_tpu import monitoring
+
         def materialize():
             st.boundary.stage_prematerialize(ctx)
-        if wd is None:
-            materialize()
-        else:
-            st.boundary._watchdog_run(ctx, wd, st.name,
-                                      materialize)
+        with monitoring.span(st.name, "stage",
+                             level=monitoring.LEVEL_QUERY):
+            if wd is None:
+                materialize()
+            else:
+                st.boundary._watchdog_run(ctx, wd, st.name,
+                                          materialize)
 
     def run_stage_threaded(st):
         set_active_catalog(catalog)

@@ -46,8 +46,8 @@ _LOG = logging.getLogger("spark_rapids_tpu.replan")
 
 
 def _metrics(ctx):
-    from spark_rapids_tpu.ops.base import Metrics
-    return ctx.metrics.setdefault("Cost@query", Metrics(owner="Cost"))
+    from spark_rapids_tpu.ops.base import query_metrics_entry
+    return query_metrics_entry(ctx, "Cost")
 
 
 def decision_key(join) -> str:
@@ -119,6 +119,11 @@ def plan_adaptive(ctx, root) -> None:
         ctx.cache[f"replan-skip:{id(probe_ex):x}"] = True
         m.add("joinDemotions", 1)
         COST._record("joinDemotions")
+        from spark_rapids_tpu import monitoring
+        monitoring.instant(
+            "join-demotion", "replan",
+            args={"join": join.name, "observedBytes": observed,
+                  "threshold": threshold})
         _LOG.warning(
             "runtime re-plan: demoting %s to broadcast (observed build "
             "side %d bytes <= threshold %d; probe shuffle skipped)",

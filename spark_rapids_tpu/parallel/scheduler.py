@@ -85,10 +85,11 @@ def reset_counters() -> None:
 
 
 def metrics_entry(ctx):
-    """The per-query Scheduler metrics entry (next to Recovery@query)."""
-    from spark_rapids_tpu.ops.base import Metrics
-    return ctx.metrics.setdefault("Scheduler@query",
-                                  Metrics(owner="Scheduler"))
+    """The per-query Scheduler metrics entry (next to Recovery@query;
+    registered level-filter exempt through the ops/base.py audit
+    registry)."""
+    from spark_rapids_tpu.ops.base import query_metrics_entry
+    return query_metrics_entry(ctx, "Scheduler")
 
 
 class QueryRejectedError(RuntimeError):
@@ -176,6 +177,9 @@ class QueryManager:
                 return self._issue(tag, 0.0, cancel)
             if len(self._waiters) >= self.queue_depth:
                 _record("rejected")
+                from spark_rapids_tpu import monitoring
+                monitoring.instant("query-rejected", "recovery",
+                                   args={"reason": "queue full"})
                 raise QueryRejectedError(
                     f"run queue full ({len(self._waiters)} queued, "
                     f"{self.max_concurrent} running)")
@@ -192,11 +196,17 @@ class QueryManager:
                         # Granted between the timeout and the lock: the
                         # slot is ours to give back.
                         self._release_slot_locked()
+                from spark_rapids_tpu import monitoring
                 if cancel is not None and cancel.is_set():
                     _record("cancelled")
+                    monitoring.instant(
+                        "query-cancelled", "recovery",
+                        args={"reason": "cancelled while queued"})
                     raise faults.QueryCancelledError(
                         -1, "cancelled while queued")
                 _record("rejected")
+                monitoring.instant("query-rejected", "recovery",
+                                   args={"reason": "admission timeout"})
                 raise QueryRejectedError(
                     f"admission timeout after "
                     f"{self.admission_timeout_ms}ms "
@@ -219,6 +229,16 @@ class QueryManager:
         self._active[token.query_id] = ticket
         _record("admitted")
         _record("queuedMs", queued_ms)
+        # Retro-record the admission wait as a "queued" span on the
+        # query's OWN track: the id the wait was for only exists now.
+        from spark_rapids_tpu import monitoring
+        if monitoring.enabled():
+            dur = int(queued_ms * 1e6)
+            monitoring.record_span(
+                "admission-queue", "queued", monitoring.now_ns() - dur,
+                dur, qid=token.query_id,
+                args={"queuedMs": round(queued_ms, 2)},
+                level=monitoring.LEVEL_QUERY)
         return ticket
 
     def _release_slot_locked(self) -> None:
@@ -262,6 +282,11 @@ class QueryManager:
                 freed += got
                 _record("crossQueryEvictions")
                 faults.record("crossQueryEvictions")
+                from spark_rapids_tpu import monitoring
+                monitoring.instant(
+                    "cross-query-eviction", "recovery",
+                    args={"requester": requester_id,
+                          "victim": t.query_id, "bytesFreed": got})
         return freed
 
     @property

@@ -168,11 +168,13 @@ def invalidate_stage(ctx, stage: Stage) -> None:
 
 def record_recompute(ctx, stage: Stage) -> None:
     """Bump the recovery counters for one stage recompute: the global
-    aggregate, the per-stage detail (bench.py's JSON emits both), and
-    the query's Recovery metrics entry."""
-    from spark_rapids_tpu import faults
-    from spark_rapids_tpu.ops.base import Metrics
+    aggregate, the per-stage detail (bench.py's JSON emits both), the
+    query's Recovery metrics entry, and a flight-recorder instant so
+    the rework shows on the trace timeline."""
+    from spark_rapids_tpu import faults, monitoring
+    from spark_rapids_tpu.ops.base import query_metrics_entry
     faults.record("stageRecomputes")
     faults.record(f"stageRecomputes.stage{stage.stage_id}")
-    rec = ctx.metrics.setdefault("Recovery@query", Metrics(owner="Recovery"))
-    rec.add("stageRecomputes", 1)
+    query_metrics_entry(ctx, "Recovery").add("stageRecomputes", 1)
+    monitoring.instant("stage-recompute", "recovery",
+                       args={"stage": stage.name})

@@ -58,10 +58,10 @@ def reset_counters() -> None:
 
 def metrics_entry(ctx):
     """The per-query Transport metrics entry (next to Recovery@query;
-    never filtered by the metrics verbosity level)."""
-    from spark_rapids_tpu.ops.base import Metrics
-    return ctx.metrics.setdefault("Transport@query",
-                                  Metrics(owner="Transport"))
+    registered level-filter exempt through the ops/base.py audit
+    registry)."""
+    from spark_rapids_tpu.ops.base import query_metrics_entry
+    return query_metrics_entry(ctx, "Transport")
 
 
 # -- registry ----------------------------------------------------------------

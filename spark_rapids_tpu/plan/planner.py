@@ -439,13 +439,17 @@ class PhysicalPlan:
     def collect(self, ctx=None, timeout_ms=None, cancel_event=None):
         import time as _time
 
-        from spark_rapids_tpu import faults
+        from spark_rapids_tpu import faults, monitoring
         from spark_rapids_tpu.memory.oom import (
             backoff_delay_ms, is_transient_error, reset_degradation)
-        from spark_rapids_tpu.ops.base import ExecContext, Metrics
+        from spark_rapids_tpu.ops.base import (ExecContext, Metrics,
+                                               query_metrics_entry)
         from spark_rapids_tpu.parallel import scheduler as SC
         from spark_rapids_tpu.parallel import stages as S
         owned = ctx is None
+        # Adopt the trace configuration BEFORE admission so the
+        # admission-queue span of THIS query records.
+        monitoring.maybe_configure(self.conf)
         # Multi-query admission (parallel/scheduler.py): one ticket per
         # top-level collect. A thread already carrying a token (a nested
         # collect issued by this same query — e.g. a gated write) rides
@@ -459,6 +463,14 @@ class PhysicalPlan:
             ticket.arm_deadline(timeout_ms)
             faults.set_query_token(ticket.token)
         ctx = ctx or ExecContext(self.conf, query=ticket)
+        # The ring the flight recorder attributes this query's events to
+        # (trace_export / explain_analyze read it off last_ctx).
+        if ticket is not None:
+            trace_qid = ticket.token.query_id
+        else:
+            tok = faults.get_query_token()
+            trace_qid = tok.query_id if tok is not None else 0
+        ctx.cache.setdefault("trace_query", trace_qid)
         if ticket is not None:
             mgr.register_context(ticket, ctx)
             sched = SC.metrics_entry(ctx)
@@ -469,7 +481,7 @@ class PhysicalPlan:
         # demotion counters to the same entry during execution.
         report = getattr(self, "cost_report", None)
         if report is not None and report.skipped is None:
-            cm = ctx.metrics.setdefault("Cost@query", Metrics(owner="Cost"))
+            cm = query_metrics_entry(ctx, "Cost")
             cm.add("placements", report.placements)
             cm.add("hostPlacedNodes", report.nodes_host_placed)
             cm.add("estDeviceMs", report.est_device_ms)
@@ -570,10 +582,10 @@ class PhysicalPlan:
                         _time.sleep(delay_ms / 1000.0)
                         ctx.close()
                         ctx = ExecContext(self.conf, query=ticket)
+                        ctx.cache.setdefault("trace_query", trace_qid)
                         if ticket is not None:
                             mgr.register_context(ticket, ctx)
-                    rec = ctx.metrics.setdefault(
-                        "Recovery@query", Metrics(owner="Recovery"))
+                    rec = query_metrics_entry(ctx, "Recovery")
                     rec.add("retriesAttempted", 1)
                     attempt += 1
         finally:
@@ -585,9 +597,16 @@ class PhysicalPlan:
                     if ticket.token.reason == "deadline exceeded":
                         sched.add("deadlineKills", 1)
                         SC._record("deadlineKills")
+                        monitoring.instant(
+                            "query-deadline-killed", "recovery",
+                            qid=trace_qid)
                     else:
                         sched.add("cancelled", 1)
                         SC._record("cancelled")
+                        monitoring.instant(
+                            "query-cancelled", "recovery",
+                            args={"reason": ticket.token.reason},
+                            qid=trace_qid)
                 faults.set_query_token(None)
                 mgr.finish(ticket)
             # Metrics survive the collect for DataFrame.metrics().
@@ -713,6 +732,15 @@ class Planner:
                                    allow_coalesce=allow_coalesce)
 
     def _convert(self, meta: NodeMeta) -> Tuple[Exec, bool]:
+        exec_, dev = self._convert_inner(meta)
+        # Tag the physical root of every logical node's conversion with
+        # the logical node's identity: explain_analyze joins observed
+        # per-exec metrics to the cost model's per-logical-node
+        # estimates through this (monitoring/analyze.py).
+        exec_._logical_id = id(meta.plan)
+        return exec_, dev
+
+    def _convert_inner(self, meta: NodeMeta) -> Tuple[Exec, bool]:
         plan = meta.plan
         want_dev = meta.on_device
         kids = [self._convert(c) for c in meta.children]

@@ -113,3 +113,14 @@ def _fault_state_isolation():
     yield
     faults.restore(state)
     oom.reset_degradation()
+
+
+@pytest.fixture(autouse=True)
+def _trace_ring_isolation():
+    """Drop recorded flight-recorder events after every test so a traced
+    test can never leak its ring contents (or query-id attribution) into
+    a later test's assertions. Configuration (e.g. an env-armed
+    SRT_TRACE=1 run) is left as-is — only the rings clear."""
+    yield
+    from spark_rapids_tpu import monitoring
+    monitoring.reset()

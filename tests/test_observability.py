@@ -65,6 +65,44 @@ def test_memory_debug_leak_report(tmp_path, caplog):
     assert any("leaked" in r.message for r in caplog.records)
 
 
+def test_audit_groups_exempt_from_metrics_level():
+    """The metrics verbosity filter must never drop the per-query audit
+    entries — and the exemption set is ONE registry (ops/base.py), not
+    per-call-site tuples (ISSUE 9 satellite)."""
+    from spark_rapids_tpu.ops.base import (audit_metric_groups,
+                                           query_metrics_entry,
+                                           register_audit_metric_group)
+    # The five built-in audit groups are pre-registered.
+    assert {"Recovery", "Pipeline", "Scheduler", "Transport",
+            "Cost"} <= audit_metric_groups()
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.metrics.level", "ESSENTIAL")
+    df = _df(s).group_by("k").agg(agg_sum(col("v")).alias("sv"))
+    df.collect()
+    phys = df._physical()
+    # Seed audit counters that ESSENTIAL would filter if they were
+    # operator metrics, plus a THIRD-PARTY group registered through the
+    # same funnel.
+    from spark_rapids_tpu.parallel import scheduler as SC
+    SC.metrics_entry(phys.last_ctx).add("crossQueryEvictions", 2)
+    query_metrics_entry(phys.last_ctx, "Recovery").add(
+        "stageRecomputes", 1)
+    query_metrics_entry(phys.last_ctx, "MyPlugin").add("customCounter", 3)
+    assert "MyPlugin" in audit_metric_groups()
+    m = df.metrics()
+    # Operator entries are filtered down to the ESSENTIAL set...
+    agg = next(v for k, v in m.items() if "HashAggregate" in k)
+    assert set(agg) <= {"numOutputRows", "totalTime"}
+    # ...audit entries keep every counter, including the plugin's.
+    assert m["Scheduler@query"]["crossQueryEvictions"] == 2
+    assert m["Recovery@query"]["stageRecomputes"] == 1
+    assert m["MyPlugin@query"]["customCounter"] == 3
+    # Idempotent re-registration.
+    register_audit_metric_group("MyPlugin")
+    assert "MyPlugin" in audit_metric_groups()
+
+
 def test_transient_error_retries_query_once(monkeypatch):
     """Failure recovery (SURVEY 5.3): a transient backend error retries
     the whole query on a fresh context; deterministic errors do not."""
