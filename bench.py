@@ -239,6 +239,129 @@ def _trace_probe(tpch_dir: str, trace_path: str) -> dict:
     }
 
 
+def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
+    """Sustained serving load (ROADMAP item 2): ``total`` parameterized
+    queries — mixed q6-class/aggregate/limit shapes with NEW literals
+    every call — submitted from ``clients`` worker threads through the
+    admission scheduler at maxConcurrentQueries=4. Every call would
+    re-plan AND re-trace without the plan cache (literal values key the
+    kernel fingerprints); with it, steady state is bind-only dispatch.
+    Reports p50/p99 latency, queries/sec, the plan-cache hit rate, the
+    mean plan+bind wall, and the q6-class bind-only speedup vs a
+    planCache.enabled=false control (the ISSUE 10 acceptance ratio)."""
+    import statistics as _st
+
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.plan import plan_cache as _pc
+    from spark_rapids_tpu.plan.logical import agg_sum, col, lit_col
+
+    def sess(cache=True):
+        s = _session()
+        s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 4)
+        s.set("spark.rapids.sql.planCache.enabled", bool(cache))
+        return s
+
+    day0 = tpch.days("1994-01-01")
+
+    def shape_q6(s, i):
+        li = tpch._read(s, tpch_dir, "lineitem")
+        lo = day0 + (i % 330)
+        f = li.filter(
+            (col("l_shipdate") >= lit_col(lo))
+            & (col("l_shipdate") < lit_col(lo + 30))
+            & (col("l_discount") >= 0.05) & (col("l_quantity") < 24.0))
+        return f.agg(agg_sum(col("l_extendedprice") * col("l_discount"))
+                     .alias("rev"))
+
+    def shape_sum(s, i):
+        li = tpch._read(s, tpch_dir, "lineitem")
+        return li.filter(col("l_quantity") < float(5 + i % 40)) \
+            .agg(agg_sum(col("l_extendedprice")).alias("s"))
+
+    def shape_limit(s, i):
+        li = tpch._read(s, tpch_dir, "lineitem")
+        return li.select("l_orderkey", "l_extendedprice") \
+            .limit(10 + i % 50)
+
+    shapes = [shape_q6, shape_sum, shape_limit]
+    s = sess()
+    t0 = time.perf_counter()
+    for i, sh in enumerate(shapes):         # cold: template + compile
+        sh(s, i).collect()
+    warmup_s = time.perf_counter() - t0
+    c0 = _pc.counters()
+    lock = threading.Lock()
+    lat: list = []
+    idx = {"i": 0}
+    errors = [0]
+
+    def client():
+        while True:
+            with lock:
+                i = idx["i"]
+                if i >= total:
+                    return
+                idx["i"] = i + 1
+            q0 = time.perf_counter()
+            try:
+                shapes[i % len(shapes)](s, i).collect()
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            took = time.perf_counter() - q0
+            with lock:
+                lat.append(took)
+
+    t0 = time.perf_counter()
+    workers = [threading.Thread(target=client, daemon=True,
+                                name=f"srt-sustained-{k}")
+               for k in range(clients)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    wall = time.perf_counter() - t0
+    c1 = _pc.counters()
+    hits = c1.get("planCacheHits", 0) - c0.get("planCacheHits", 0)
+    misses = c1.get("planCacheMisses", 0) - c0.get("planCacheMisses", 0)
+    bind_ns = c1.get("planBindNs", 0) - c0.get("planBindNs", 0)
+    lat.sort()
+
+    def pct(q):
+        return round(lat[min(int(q * len(lat)), len(lat) - 1)] * 1000, 2) \
+            if lat else None
+
+    # q6-class cold-vs-warm acceptance ratio: fresh literals every call,
+    # plan cache on vs off (off re-plans AND re-traces per call).
+    def serial(cache, n, off):
+        ss = sess(cache)
+        shape_q6(ss, off - 1).collect()     # conf-specific warm
+        t = time.perf_counter()
+        for i in range(n):
+            shape_q6(ss, off + i).collect()
+        return (time.perf_counter() - t) / n
+    on_s = serial(True, 6, 500)
+    off_s = serial(False, 6, 600)
+    return {
+        "queries": total, "clients": clients, "errors": errors[0],
+        "max_concurrent": 4,
+        "warmup_s": round(warmup_s, 3),
+        "wall_s": round(wall, 3),
+        "qps": round(len(lat) / wall, 2) if wall > 0 else None,
+        "p50_ms": pct(0.50), "p99_ms": pct(0.99),
+        "mean_ms": round(_st.mean(lat) * 1000, 2) if lat else None,
+        "plan_cache_hits": hits, "plan_cache_misses": misses,
+        "plan_cache_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "plan_bind_ms_mean": round(
+            bind_ns / 1e6 / max(hits + misses, 1), 3),
+        "q6_bind_only_s": round(on_s, 4),
+        "q6_replan_retrace_s": round(off_s, 4),
+        "q6_speedup_vs_plan_cache_off": round(off_s / on_s, 2)
+        if on_s > 0 else None,
+    }
+
+
 def _concurrency_probe(tpch_dir: str, n: int) -> dict:
     """N-query throughput: N fresh sessions run hot q6 serially, then
     the same N concurrently through the scheduler (each on its own
@@ -351,6 +474,13 @@ def main():
         # the budget allows).
         "scheduler": {},
         "concurrency": {},
+        # Parameterized plan cache (plan/plan_cache.py): template
+        # hits/misses + bind-only executions for the whole run, and the
+        # sustained-load serving block (N clients x mixed parameterized
+        # shapes at maxConcurrentQueries=4 — p50/p99, qps, hit rate,
+        # and the q6-class bind-only-vs-replan speedup).
+        "plan_cache": {},
+        "sustained": {},
         # Shuffle transport SPI (parallel/transport/): which transport
         # served the run plus its byte/shard counters — nonzero
         # remoteShardRefetches/remoteShardsLost say the run recovered
@@ -495,6 +625,19 @@ def main():
         with _LOCK:
             out["concurrency"] = conc
 
+    # Sustained serving load through the plan cache: the "millions of
+    # users" block — mixed parameterized shapes, new literals per call.
+    if "q6" in _STATE["ok"] and _remaining(budget) > 60:
+        try:
+            sus = _sustained_probe(
+                packs["q6"][1],
+                int(os.environ.get("BENCH_SUSTAINED_QUERIES", "200")),
+                int(os.environ.get("BENCH_SUSTAINED_CLIENTS", "4")))
+        except Exception as e:  # the headline must survive a probe bug
+            sus = {"error": f"{type(e).__name__}: {e}"}
+        with _LOCK:
+            out["sustained"] = sus
+
     from spark_rapids_tpu.parallel import scheduler as _sched
     with _LOCK:
         sch = _sched.counters()
@@ -546,6 +689,15 @@ def main():
             cs.setdefault(name, 0)
         cs["enabled"] = _cost.cost_enabled(_C.TpuConf())
         out["cost"] = cs
+        from spark_rapids_tpu.plan import plan_cache as _plc
+        plc = _plc.counters()
+        for name in ("planCacheHits", "planCacheMisses",
+                     "bindOnlyExecutions", "planCacheBypasses",
+                     "planCacheUncacheable", "planBindNs"):
+            plc.setdefault(name, 0)
+        plc["entries"] = _plc.cache().stats()["entries"]
+        plc["enabled"] = _plc.plan_cache_enabled(_C.TpuConf())
+        out["plan_cache"] = plc
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

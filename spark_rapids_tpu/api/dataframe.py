@@ -331,16 +331,28 @@ class DataFrame:
 
     # -- actions --------------------------------------------------------------
     def _physical(self):
-        # Plan once per (DataFrame, conf version): repeated collects reuse
-        # the same Exec tree so per-exec jitted kernels stay compiled
-        # (re-planning every action would re-trace everything).
+        # Plan once per (DataFrame, conf version); the process-global
+        # parameterized plan cache (plan/plan_cache.py) additionally
+        # shares fully planned templates ACROSS DataFrames of the same
+        # shape — a repeat query with new literals binds against the
+        # cached template instead of re-planning and re-tracing.
         key = self._session.conf.version
         cached = getattr(self, "_phys_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        phys = Planner(self._session.conf).plan(self._plan)
+        from spark_rapids_tpu.plan.plan_cache import plan_or_bind
+        phys = plan_or_bind(self._session.conf, self._plan)
         self._phys_cache = (key, phys)
         return phys
+
+    def prepare(self):
+        """Explicit prepared-statement handle: plan NOW (or bind
+        against the process-global plan cache) and return the bound
+        plan — its ``collect()``/``explain()`` skip all planning work,
+        and ``cache_hit``/``bind_values`` expose the plan-cache
+        provenance. Useful for warming a serving tier's shapes before
+        traffic arrives (scripts/warmup.py drives this)."""
+        return self._physical()
 
     def collect(self, timeout_ms: Optional[float] = None) -> List[tuple]:
         """Run the query through the multi-query scheduler
@@ -398,6 +410,11 @@ class DataFrame:
             self.collect()
         from spark_rapids_tpu.monitoring.analyze import render
         report = render(phys, getattr(phys, "last_ctx", None))
+        # Plan provenance: a cache-hit (bind-only) execution must not
+        # silently look identical to a freshly planned one.
+        prov = getattr(phys, "provenance", None)
+        if prov:
+            report = f"[{prov}]\n{report}"
         print(report)
         return report
 
@@ -441,6 +458,9 @@ class DataFrame:
             "to_jax needs a device plan (sql.enabled off?)"
         ctx = ExecContext(phys.conf)
         ctx.cache.setdefault("engine", "device")
+        install = getattr(phys, "install", None)
+        if install is not None:     # bound plan: thread the literals in
+            install(ctx)
         root = phys.root
         # Same device-admission + OOM-recovery regime as collect():
         # the semaphore bounds concurrent device users, the registered

@@ -714,6 +714,25 @@ SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS = conf(
     "manifests before failing with a lost-shard error (which flows "
     "into the recovery ladder).").integer(30000)
 
+PLAN_CACHE_ENABLED = conf("spark.rapids.sql.planCache.enabled").doc(
+    "Parameterized plan cache (plan/plan_cache.py): keep fully "
+    "planned/fused/cost-placed physical plan templates in a "
+    "process-global LRU keyed by the logical plan's structural "
+    "fingerprint (literal VALUES hoisted into bind slots) + input "
+    "schemas + the conf snapshot. A repeat execution with the same "
+    "shape and new literals (filter constants, date ranges, limits) "
+    "skips analysis/planning/fusion/cost placement entirely and binds "
+    "its literals as runtime scalar kernel inputs, so compiled "
+    "executables are shared across bindings too. Armed fault schedules "
+    "bypass the cache; any conf change misses it. The SRT_PLAN_CACHE "
+    "env (0/1) overrides the default for a whole process.").boolean(True)
+
+PLAN_CACHE_MAX_ENTRIES = conf("spark.rapids.sql.planCache.maxEntries").doc(
+    "LRU bound on the parameterized plan cache. Each entry pins one "
+    "physical plan template (exec tree + tagged meta — no compiled "
+    "kernels; those live in the kernel cache) plus, for in-memory "
+    "sources, the source batches its key identifies.").integer(256)
+
 
 class TpuConf:
     """Resolved view over a raw key->value dict (Spark SQL conf stand-in)."""
@@ -1004,6 +1023,33 @@ def generate_docs() -> str:
         "and `aqe.coalescePartitions.targetBytes` hold. Decisions and",
         "estimate-vs-actual error surface in the `Cost@query` metrics",
         "entry and bench.py's `cost` JSON block. See docs/performance.md.",
+        "",
+        "## Parameterized plan cache",
+        "",
+        "With `spark.rapids.sql.planCache.enabled` (default true;",
+        "`SRT_PLAN_CACHE=0` disables for a whole process) every",
+        "`collect()` first rewrites its logical plan's bindable literal",
+        "leaves (numeric/bool/date operands of comparisons and",
+        "arithmetic in filters and projections, plus `limit(n)` values)",
+        "into positional BIND SLOTS, then looks the parameterized shape",
+        "up in a process-global LRU keyed by (structural plan",
+        "fingerprint, input schemas, conf snapshot). A hit skips",
+        "analysis, planning, capability tagging, fusion and cost",
+        "placement entirely — the cached physical template executes with",
+        "this call's literals bound as runtime scalar kernel inputs, so",
+        "kernel-cache fingerprints (and compiled XLA executables) are",
+        "shared across bindings and a re-parameterized query re-traces",
+        "nothing. Per-query state (ExecContext, owner tags, AQE replan",
+        "decisions, trace rings) stays per-execution. Invalidation is",
+        "conservative: ANY conf change, schema change, or armed fault",
+        "schedule misses or bypasses the cache. `explain()` annotates",
+        "provenance (`[plan-cache hit, bind-only]`), `DataFrame.prepare()`",
+        "returns the bound template as an explicit prepared-statement",
+        "handle, and `scripts/warmup.py` replays a shape manifest so a",
+        "fresh process serves its first query without the cold-compile",
+        "cliff. Counters (planCacheHits/Misses/bindOnlyExecutions) land",
+        "in bench.py's `plan_cache` block and per-tenant on the",
+        "`Scheduler@query` metrics entry. See docs/performance.md.",
         "",
         "## Query flight recorder",
         "",

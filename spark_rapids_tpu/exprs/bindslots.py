@@ -1,0 +1,166 @@
+"""Literal bind slots: runtime-bound literals for the parameterized plan
+cache (plan/plan_cache.py).
+
+A ``Literal`` is a trace-time CONSTANT: jax bakes its value into the
+compiled program, so the kernel cache must fold literal values into its
+structural fingerprints and a repeated query with a new filter constant
+re-traces every kernel it touches. A :class:`BindSlotExpr` is the
+value-free replacement the plan cache hoists bindable literals into: the
+expression carries only ``(slot, dtype)`` — the VALUE arrives at
+execution time through :func:`bound_literals`, as a traced jnp scalar on
+the device path (a runtime kernel input, so one compiled executable
+serves every binding of the same dtype) and as a plain python value on
+the host path.
+
+Plumbing contract (mirrors exprs/nondeterministic.EvalContext):
+
+- The execution's binding vector lives in ``ctx.cache["plan_binds"]``
+  (python values) + ``ctx.cache["plan_bind_dtypes"]`` — installed by
+  ``PhysicalPlan.collect`` from the bound plan, so it reaches pipeline
+  prefetch threads, stage workers and watchdog attempts for free.
+- Kernel CALL SITES (Project/Filter/FusedStage and the contextual loop,
+  ops/) fetch :func:`device_bind_args` and pass the tuple as an extra
+  jitted argument; inside the traced function the body runs under
+  ``with bound_literals(binds)`` so :meth:`BindSlotExpr.eval` reads its
+  slot as a tracer. Host paths wrap their eval in
+  ``bound_literals(host_bind_args(ctx))`` with raw python values.
+- Plan attributes that stay host-side python ints (limit budgets, scan
+  pushdown predicate values) use :class:`BindValue` markers resolved via
+  :func:`resolve_bound`.
+
+This module deliberately imports only exprs.base + columnar leaves so
+every layer above (ops, plan) can use it without cycles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.columnar.batch import DeviceColumn
+from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.exprs.base import Expression, Scalar
+
+_BOUND: contextvars.ContextVar[Optional[Tuple]] = \
+    contextvars.ContextVar("srt_bound_literals", default=None)
+
+
+@contextlib.contextmanager
+def bound_literals(values: Sequence[Any]):
+    """Install the execution's binding vector for the enclosed eval.
+    Under jit this runs at TRACE time, so slot reads become traced
+    inputs of the compiled program."""
+    token = _BOUND.set(tuple(values))
+    try:
+        yield
+    finally:
+        _BOUND.reset(token)
+
+
+def current_bound_literals() -> Optional[Tuple]:
+    return _BOUND.get()
+
+
+@dataclasses.dataclass
+class BindSlotExpr(Expression):
+    """A hoisted literal: dtype-typed, VALUE-FREE leaf. Two bindings of
+    the same dtype share one kernel-cache fingerprint — the cache
+    correctness contract is preserved because the value is a runtime
+    input, never a trace constant."""
+
+    slot: int
+    dtype: DataType
+
+    def data_type(self) -> DataType:
+        return self.dtype
+
+    def _value(self):
+        vals = _BOUND.get()
+        if vals is None or self.slot >= len(vals):
+            raise RuntimeError(
+                f"bind slot {self.slot} evaluated without bound literals "
+                "(plan-cache template executed outside a bound "
+                "collect?)")
+        return vals[self.slot]
+
+    def eval(self, batch) -> DeviceColumn:
+        val = self._value()
+        mask = batch.row_mask()
+        # Same expansion expand_scalar does for a non-null scalar, but
+        # tracer-safe: the value may be a traced jnp scalar.
+        data = jnp.where(mask, jnp.asarray(val).astype(self.dtype.np_dtype),
+                         jnp.zeros((), self.dtype.np_dtype))
+        return DeviceColumn(self.dtype, data, mask)
+
+    def eval_host(self, batch) -> Scalar:
+        v = self._value()
+        if hasattr(v, "item"):      # device scalar leaked to host path
+            v = v.item()
+        if self.dtype is dt.BOOL:
+            v = bool(v)
+        elif self.dtype.is_integral or self.dtype.is_datetime:
+            v = int(v)
+        elif self.dtype.is_floating:
+            v = float(v)
+        return Scalar(self.dtype, v)
+
+    def pretty(self) -> str:
+        return f"?{self.slot}:{self.dtype.name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class BindValue:
+    """Slot marker for host-side python plan attributes (limit budgets,
+    scan pushdown predicate values): resolved at execute time via
+    :func:`resolve_bound`, never traced."""
+
+    slot: int
+
+
+def resolve_bound(v: Any, ctx) -> Any:
+    """Resolve a possibly-slot-bound plan attribute to its value for
+    THIS execution (``ctx.cache['plan_binds']``)."""
+    if not isinstance(v, BindValue):
+        return v
+    binds = None if ctx is None else ctx.cache.get("plan_binds")
+    if binds is None:
+        binds = current_bound_literals()
+    if binds is None or v.slot >= len(binds):
+        raise RuntimeError(
+            f"bind value slot {v.slot} resolved without bound literals")
+    return binds[v.slot]
+
+
+def has_bind_slots(exprs: Sequence[Expression]) -> bool:
+    """True when any expression tree contains a bind slot (the call-site
+    gate for passing the binding vector into the jitted kernel)."""
+    def rec(e: Expression) -> bool:
+        if isinstance(e, BindSlotExpr):
+            return True
+        return any(rec(c) for c in e.children)
+    return any(rec(e) for e in exprs)
+
+
+def device_bind_args(ctx) -> Tuple:
+    """This execution's binding vector as dtype-committed jnp scalars,
+    built once per context (the tuple is what call sites pass as the
+    extra jitted argument — stable dtypes mean a stable jit signature
+    across bindings)."""
+    cached = ctx.cache.get("plan_binds_dev")
+    if cached is None:
+        vals = ctx.cache.get("plan_binds") or ()
+        dts = ctx.cache.get("plan_bind_dtypes") or ()
+        cached = tuple(jnp.asarray(v, t.np_dtype)
+                       for v, t in zip(vals, dts))
+        ctx.cache["plan_binds_dev"] = cached
+    return cached
+
+
+def host_bind_args(ctx) -> Tuple:
+    """The raw python binding vector for host-engine eval."""
+    return tuple(ctx.cache.get("plan_binds") or ())

@@ -388,14 +388,36 @@ class FileScanExec(LeafExec):
     def num_partitions(self, ctx) -> int:
         return self._parts
 
-    def _units_of(self, partition: int, m=None) -> List[ScanUnit]:
+    def _resolved_predicates(self, ctx) -> Tuple:
+        """Pushed conjuncts with plan-cache bind slots resolved against
+        THIS execution's binding vector (``ctx.cache['plan_binds']``).
+        A slot predicate with no bindings in scope is dropped — stats
+        skipping is an optimization; the filter above still runs."""
+        from spark_rapids_tpu.exprs.bindslots import BindValue
+        if not any(isinstance(v, BindValue)
+                   for _, _, v in self.predicates):
+            return self.predicates
+        binds = None if ctx is None else ctx.cache.get("plan_binds")
+        out = []
+        for name, op, value in self.predicates:
+            if isinstance(value, BindValue):
+                if binds is None or value.slot >= len(binds):
+                    continue
+                value = binds[value.slot]
+            out.append((name, op, value))
+        return tuple(out)
+
+    def _units_of(self, partition: int, m=None, ctx=None) -> List[ScanUnit]:
         """This partition's units, minus stats-skipped ones."""
         mine = [u for i, u in enumerate(self._units)
                 if i % self._parts == partition]
         if not self.predicates:
             return mine
+        predicates = self._resolved_predicates(ctx)
+        if not predicates:
+            return mine
         kept = [u for u in mine
-                if _unit_survives(self.fmt, u, self.predicates)]
+                if _unit_survives(self.fmt, u, predicates)]
         if m is not None and len(kept) < len(mine):
             m.add("numSkippedRowGroups", len(mine) - len(kept))
         return kept
@@ -427,7 +449,7 @@ class FileScanExec(LeafExec):
     # -- host engine ---------------------------------------------------------
     def execute_host(self, ctx, partition):
         rows = self._batch_rows(ctx)
-        for unit in self._units_of(partition):
+        for unit in self._units_of(partition, ctx=ctx):
             self._publish_input_file(ctx, partition, unit.path, host=True)
             yield from _read_unit_batches(self.fmt, unit, self.options,
                                           rows, self._columns)
@@ -455,7 +477,7 @@ class FileScanExec(LeafExec):
         m = ctx.metrics_for(self)
         rt = self._reader_type(ctx)
         rows = self._batch_rows(ctx)
-        units = self._units_of(partition, m)
+        units = self._units_of(partition, m, ctx=ctx)
         budget = int(ctx.conf.get(C.SCAN_CACHE_BYTES))
         use_cache = budget > 0 and rt != "COALESCING"
         payload: List[tuple] = []
@@ -599,7 +621,7 @@ class FileScanExec(LeafExec):
                 ctx, m, pre, rows, partition,
                 int(ctx.conf.get(C.SCAN_CACHE_BYTES)))
             return
-        units = self._units_of(partition, m)
+        units = self._units_of(partition, m, ctx=ctx)
         budget = int(ctx.conf.get(C.SCAN_CACHE_BYTES))
         # COALESCING merges units into one upload, so its outputs have no
         # per-unit identity to cache under; the per-unit strategies cache.

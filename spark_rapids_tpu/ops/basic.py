@@ -21,6 +21,9 @@ from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
 from spark_rapids_tpu.exprs.base import (
     Expression, as_device_column, as_host_column, eval_exprs,
     eval_exprs_host)
+from spark_rapids_tpu.exprs.bindslots import (
+    bound_literals, device_bind_args, has_bind_slots, host_bind_args,
+    resolve_bound)
 from spark_rapids_tpu.exprs.nondeterministic import (
     EvalContext, eval_context, needs_eval_context)
 from spark_rapids_tpu.ops import kernel_cache as kc
@@ -70,10 +73,12 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
     """
     m = ctx.metrics_for(op)
     jittable = all(e.jittable for e in exprs)
+    binds = device_bind_args(ctx) if has_bind_slots(exprs) else None
     if jittable:
         def build():
-            def kfn(b, pid, base):
-                with eval_context(EvalContext(pid, base)):
+            def kfn(b, pid, base, bv=()):
+                with eval_context(EvalContext(pid, base)), \
+                        bound_literals(bv):
                     out = kernel(b)
                 return out, base + b.num_rows.astype(jnp.int64)
             return jax.jit(kfn)
@@ -84,9 +89,11 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
         for batch in op.children[0].execute_device(ctx, partition):
             entry = kc.lookup(
                 "ctx-" + type(op).__name__,
-                (fp, schema_fp, batch.capacity), build, m)
+                (fp, schema_fp, batch.capacity,
+                 len(binds) if binds else 0), build, m)
             with timed(m):
-                out, base = kc.call(entry, m, batch, pid, base)
+                out, base = kc.call(entry, m, batch, pid, base,
+                                    binds or ())
             record_batch(m, out)
             yield out
     else:
@@ -95,7 +102,8 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
         for batch in op.children[0].execute_device(ctx, partition):
             ec = EvalContext(partition, base,
                              ctx.cache.get(key) if key else None)
-            with timed(m), eval_context(ec):
+            with timed(m), eval_context(ec), \
+                    bound_literals(binds or ()):
                 out = kernel(batch)
             base = base + batch.num_rows.astype(jnp.int64)
             record_batch(m, out)
@@ -103,14 +111,16 @@ def _contextual_device_loop(op: Exec, exprs: Sequence[Expression],
 
 
 def _contextual_host_loop(op: Exec, kernel, ctx: ExecContext,
-                          partition: int):
+                          partition: int, exprs=()):
     base = 0
     key = _input_file_key(op, partition, host=True)
+    binds = host_bind_args(ctx) if has_bind_slots(exprs) else ()
     for hb in op.children[0].execute_host(ctx, partition):
         ec = EvalContext(partition, base,
                          ctx.cache.get(key) if key else None)
-        with eval_context(ec):
-            yield kernel(hb)
+        with eval_context(ec), bound_literals(binds):
+            out = kernel(hb)
+        yield out
         base += hb.num_rows
 
 
@@ -140,15 +150,30 @@ class ProjectExec(Exec):
         jittable = all(e.jittable for e in exprs)
         fp = kc.fingerprint(tuple(exprs)) if jittable else None
         schema_fp = kc.schema_fingerprint(self.children[0].schema)
+        binds = device_bind_args(ctx) if has_bind_slots(exprs) else None
         for batch in self.children[0].execute_device(ctx, partition):
-            if jittable:
+            if jittable and binds is not None:
+                # Bound literals ride as traced runtime inputs: one
+                # compiled kernel serves every binding of these dtypes.
+                def build():
+                    def kfn(b, bv):
+                        with bound_literals(bv):
+                            return eval_exprs(exprs, b)
+                    return jax.jit(kfn)
+                entry = kc.lookup(
+                    "project",
+                    (fp, schema_fp, batch.capacity, len(binds)),
+                    build, m)
+                with timed(m):
+                    out = kc.call(entry, m, batch, binds)
+            elif jittable:
                 entry = kc.lookup(
                     "project", (fp, schema_fp, batch.capacity),
                     lambda: jax.jit(lambda b: eval_exprs(exprs, b)), m)
                 with timed(m):
                     out = kc.call(entry, m, batch)
             else:
-                with timed(m):
+                with timed(m), bound_literals(binds or ()):
                     out = eval_exprs(exprs, batch)
             # Projection preserves row count — keep the host-known hint so
             # downstream size consumers skip their device sync.
@@ -160,10 +185,16 @@ class ProjectExec(Exec):
         if needs_eval_context(self.exprs):
             yield from _contextual_host_loop(
                 self, lambda hb: eval_exprs_host(self.exprs, hb, self.names),
-                ctx, partition)
+                ctx, partition, self.exprs)
             return
+        binds = host_bind_args(ctx) if has_bind_slots(self.exprs) else None
         for hb in self.children[0].execute_host(ctx, partition):
-            yield eval_exprs_host(self.exprs, hb, self.names)
+            if binds is not None:
+                with bound_literals(binds):
+                    out = eval_exprs_host(self.exprs, hb, self.names)
+                yield out
+            else:
+                yield eval_exprs_host(self.exprs, hb, self.names)
 
 
 class FilterExec(Exec):
@@ -210,15 +241,29 @@ class FilterExec(Exec):
         jittable = condition.jittable
         fp = kc.fingerprint(condition) if jittable else None
         schema_fp = kc.schema_fingerprint(self.children[0].schema)
+        binds = device_bind_args(ctx) \
+            if has_bind_slots([condition]) else None
         for batch in self.children[0].execute_device(ctx, partition):
-            if jittable:
+            if jittable and binds is not None:
+                def build():
+                    def kfn(b, bv):
+                        with bound_literals(bv):
+                            return kernel(b)
+                    return jax.jit(kfn)
+                entry = kc.lookup(
+                    "filter",
+                    (fp, schema_fp, batch.capacity, len(binds)),
+                    build, m)
+                with timed(m):
+                    out = kc.call(entry, m, batch, binds)
+            elif jittable:
                 entry = kc.lookup(
                     "filter", (fp, schema_fp, batch.capacity),
                     lambda: jax.jit(kernel), m)
                 with timed(m):
                     out = kc.call(entry, m, batch)
             else:
-                with timed(m):
+                with timed(m), bound_literals(binds or ()):
                     out = kernel(batch)
             record_batch(m, out)
             yield out
@@ -226,10 +271,18 @@ class FilterExec(Exec):
     def execute_host(self, ctx, partition):
         if needs_eval_context([self.condition]):
             yield from _contextual_host_loop(
-                self, self._host_kernel, ctx, partition)
+                self, self._host_kernel, ctx, partition,
+                [self.condition])
             return
+        binds = host_bind_args(ctx) \
+            if has_bind_slots([self.condition]) else None
         for hb in self.children[0].execute_host(ctx, partition):
-            yield self._host_kernel(hb)
+            if binds is not None:
+                with bound_literals(binds):
+                    out = self._host_kernel(hb)
+                yield out
+            else:
+                yield self._host_kernel(hb)
 
 
 class UnionExec(Exec):
@@ -366,6 +419,8 @@ class LocalLimitExec(Exec):
 
     def __init__(self, child: Exec, limit: int):
         super().__init__(child)
+        # A plain int, or a bindslots.BindValue slot the plan cache
+        # hoisted: resolved per execution against ctx's binding vector.
         self.limit = limit
 
     @property
@@ -373,7 +428,7 @@ class LocalLimitExec(Exec):
         return self.children[0].schema
 
     def execute_device(self, ctx, partition):
-        remaining = self.limit
+        remaining = int(resolve_bound(self.limit, ctx))
         for batch in self.children[0].execute_device(ctx, partition):
             if remaining <= 0:
                 break
@@ -390,7 +445,7 @@ class LocalLimitExec(Exec):
             yield out
 
     def execute_host(self, ctx, partition):
-        remaining = self.limit
+        remaining = int(resolve_bound(self.limit, ctx))
         for hb in self.children[0].execute_host(ctx, partition):
             if remaining <= 0:
                 break

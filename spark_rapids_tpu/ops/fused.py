@@ -31,6 +31,8 @@ import jax.numpy as jnp
 
 from spark_rapids_tpu.columnar.batch import DeviceBatch
 from spark_rapids_tpu.exprs.base import as_device_column, eval_exprs
+from spark_rapids_tpu.exprs.bindslots import (
+    bound_literals, device_bind_args, has_bind_slots, resolve_bound)
 from spark_rapids_tpu.ops import kernel_cache as kc
 from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
     record_batch, timed)
@@ -60,14 +62,34 @@ def _stage_specs(ops: Sequence[Exec]) -> List[Tuple[str, object]]:
     return specs
 
 
+def _spec_exprs(specs: Sequence[Tuple[str, object]]):
+    """Every expression the fused stage evaluates (bind-slot probe)."""
+    out = []
+    for kind, payload in specs:
+        if kind == "project":
+            out.extend(payload)
+        elif kind == "filter":
+            out.append(payload)
+        elif kind == "expand":
+            out.extend(e for proj in payload for e in proj)
+    return out
+
+
 def _build_fused(specs: Sequence[Tuple[str, object]]):
     """Compose the member kernels into one batch->batches function.
 
-    Signature: ``fused(batch, rems) -> (outputs, rems_out)`` where
-    ``rems`` is a tuple of int32 scalars — one remaining-row budget per
-    LocalLimit member, threaded through the trace."""
+    Signature: ``fused(batch, rems, binds) -> (outputs, rems_out)``
+    where ``rems`` is a tuple of int32 scalars — one remaining-row
+    budget per LocalLimit member — and ``binds`` the execution's bound
+    literals (empty when the stage has no bind slots), both threaded
+    through the trace as runtime inputs so one compilation serves the
+    whole partition stream AND every literal binding."""
 
-    def fused(batch: DeviceBatch, rems):
+    def fused(batch: DeviceBatch, rems, binds=()):
+        with bound_literals(binds):
+            return _fused_body(batch, rems)
+
+    def _fused_body(batch: DeviceBatch, rems):
         outs = [batch]
         rems = list(rems)
         for kind, payload in specs:
@@ -111,6 +133,7 @@ class FusedStageExec(Exec):
                         if isinstance(op, LocalLimitExec)]
         self._pure_project = all(k == "project" for k, _ in self._specs)
         self._fp = kc.fingerprint(tuple(self._specs))
+        self._has_binds = has_bind_slots(_spec_exprs(self._specs))
 
     @property
     def schema(self) -> Schema:
@@ -126,14 +149,17 @@ class FusedStageExec(Exec):
         m.values.setdefault("numFusedStages", 1)
         m.values.setdefault("numFusedOps", len(self.ops))
         schema_fp = kc.schema_fingerprint(self.children[0].schema)
-        rems = tuple(jnp.asarray(n, jnp.int32) for n in self._limits)
+        rems = tuple(jnp.asarray(int(resolve_bound(n, ctx)), jnp.int32)
+                     for n in self._limits)
+        binds = device_bind_args(ctx) if self._has_binds else ()
         specs = self._specs
         for batch in self.children[0].execute_device(ctx, partition):
             entry = kc.lookup(
-                "fused-stage", (self._fp, schema_fp, batch.capacity),
+                "fused-stage",
+                (self._fp, schema_fp, batch.capacity, len(binds)),
                 lambda: jax.jit(_build_fused(specs)), m)
             with timed(m):
-                outs, rems = kc.call(entry, m, batch, rems)
+                outs, rems = kc.call(entry, m, batch, rems, binds)
             for out in outs:
                 if self._pure_project:
                     # Row count unchanged by pure projection chains —

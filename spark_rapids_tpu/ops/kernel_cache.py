@@ -73,6 +73,16 @@ def _fp(v: Any, depth: int) -> Any:
         return ("npdt", v.str)
     if isinstance(v, np.generic):
         return ("npv", v.dtype.str, repr(v.item()))
+    if type(v).__name__ == "BindSlotExpr":
+        # Bound literals (exprs/bindslots.py) are VALUE-FREE by
+        # construction: the key carries (slot, dtype) only, so two
+        # bindings of the same dtype share ONE compiled kernel — the
+        # binding arrives as a traced runtime input, never a trace
+        # constant. Plain Literal nodes keep their value in the key
+        # (the generic walk below), which stays correct: an unhoisted
+        # literal IS a trace constant. Duck-typed on the class name so
+        # this module keeps its no-engine-imports rule.
+        return ("bindslot", v.slot, v.dtype.name)
     if isinstance(v, (list, tuple)):
         return tuple(_fp(x, depth + 1) for x in v)
     if isinstance(v, (set, frozenset)):

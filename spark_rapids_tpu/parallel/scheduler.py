@@ -92,6 +92,18 @@ def metrics_entry(ctx):
     return query_metrics_entry(ctx, "Scheduler")
 
 
+def record_plan_cache(ctx, hit: bool) -> None:
+    """Per-tenant plan-cache outcome (plan/plan_cache.py) on the query's
+    Scheduler@query entry plus the process counters bench.py's
+    ``scheduler`` block reports: ``planCacheBindOnly`` executions
+    skipped planning entirely (plan once, bind literals, dispatch);
+    ``planCacheMiss`` executions paid a template plan this tenant's
+    later calls amortize."""
+    name = "planCacheBindOnly" if hit else "planCacheMiss"
+    metrics_entry(ctx).add(name, 1)
+    _record(name)
+
+
 class QueryRejectedError(RuntimeError):
     """Load shed: the run queue was full, or the admission wait timed
     out. Deliberately NOT a transient error (no retry marker): the

@@ -654,6 +654,11 @@ def resolve(c: Column, schema: Schema) -> Expression:
         if v is None:
             raise ResolutionError("untyped NULL literal; use typed lit")
         return E.lit(v)
+    if kind == "bindslot":
+        # Hoisted literal (plan/plan_cache.py): value-free leaf whose
+        # binding arrives at execution time as a runtime kernel input.
+        from spark_rapids_tpu.exprs.bindslots import BindSlotExpr
+        return BindSlotExpr(node[1], node[2])
     if kind == "alias":
         return rec(node[1])
     if kind == "cast":

@@ -436,7 +436,14 @@ class PhysicalPlan:
             lines.extend(report.explain_lines())
         return "\n".join(lines)
 
-    def collect(self, ctx=None, timeout_ms=None, cancel_event=None):
+    def collect(self, ctx=None, timeout_ms=None, cancel_event=None,
+                bindings=None, plan_cache_hit=None):
+        """``bindings`` is the plan cache's ``(values, dtypes)`` pair for
+        a parameterized template: installed into every execution
+        context (including fresh-context retries) so bind slots, limit
+        budgets and scan predicates resolve to THIS call's literals.
+        ``plan_cache_hit`` (when not None) records the per-tenant
+        plan-cache outcome on the Scheduler@query entry."""
         import time as _time
 
         from spark_rapids_tpu import faults, monitoring
@@ -463,6 +470,13 @@ class PhysicalPlan:
             ticket.arm_deadline(timeout_ms)
             faults.set_query_token(ticket.token)
         ctx = ctx or ExecContext(self.conf, query=ticket)
+
+        def install_bindings(c):
+            if bindings is not None:
+                c.cache["plan_binds"] = tuple(bindings[0])
+                c.cache["plan_bind_dtypes"] = tuple(bindings[1])
+
+        install_bindings(ctx)
         # The ring the flight recorder attributes this query's events to
         # (trace_export / explain_analyze read it off last_ctx).
         if ticket is not None:
@@ -476,6 +490,11 @@ class PhysicalPlan:
             sched = SC.metrics_entry(ctx)
             sched.add("admitted", 1)
             sched.add("queuedMs", ticket.queued_ms)
+            if plan_cache_hit is not None:
+                # Per-tenant plan-cache stats (plan/plan_cache.py): a
+                # hit means this execution was bind-only — zero
+                # re-plan, zero re-trace.
+                SC.record_plan_cache(ctx, plan_cache_hit)
         # Cost@query audit trail: static placement decisions land here at
         # admission; runtime re-planning (parallel/replan.py) adds its
         # demotion counters to the same entry during execution.
@@ -582,6 +601,7 @@ class PhysicalPlan:
                         _time.sleep(delay_ms / 1000.0)
                         ctx.close()
                         ctx = ExecContext(self.conf, query=ticket)
+                        install_bindings(ctx)
                         ctx.cache.setdefault("trace_query", trace_qid)
                         if ticket is not None:
                             mgr.register_context(ticket, ctx)

@@ -68,7 +68,12 @@ def _conjuncts(c: Column, out: list):
 
 
 def _as_predicate(c: Column):
-    """(name, op, value) for a supported conjunct, else None."""
+    """(name, op, value) for a supported conjunct, else None. A
+    plan-cache bind slot pushes as a ``BindValue`` marker the scan
+    resolves against the EXECUTION's binding vector — row-group
+    stats skipping must see this call's literal, never the one the
+    template was first planned with."""
+    from spark_rapids_tpu.exprs.bindslots import BindValue
     node = c.node
     kind = node[0]
     if kind == "isnotnull" and node[1].node[0] == "ref":
@@ -79,6 +84,10 @@ def _as_predicate(c: Column):
             return (l.node[1], kind, r.node[1])
         if l.node[0] == "lit" and r.node[0] == "ref":
             return (r.node[1], _FLIP[kind], l.node[1])
+        if l.node[0] == "ref" and r.node[0] == "bindslot":
+            return (l.node[1], kind, BindValue(r.node[1]))
+        if l.node[0] == "bindslot" and r.node[0] == "ref":
+            return (r.node[1], _FLIP[kind], BindValue(l.node[1]))
     return None
 
 

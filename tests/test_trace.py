@@ -239,7 +239,8 @@ _SUITES_SLOW = ["q67", "xbb_q5", "ds_q3", "xbb_q12"]
 # monotonically across collects) — legitimately run-order-dependent,
 # excluded from the trace-on/off shape comparison.
 _CACHE_COUNTERS = {"kernelCacheHits", "kernelCacheMisses", "compileTime",
-                   "scanCacheHits", "persistentCacheHits"}
+                   "scanCacheHits", "persistentCacheHits",
+                   "planCacheMiss", "planCacheBindOnly"}
 
 
 def _metric_shape(metrics: dict):
