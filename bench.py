@@ -497,6 +497,11 @@ def main():
         # microbench that produces the scan_gb_per_sec headline.
         "wire": {},
         "scan_bench": {},
+        # Native Pallas kernel layer (ops/native.py): the enabled
+        # kernel set (empty on CPU — the layer no-ops to the jax.numpy
+        # fallback there), per-kernel trace counts, and the cost
+        # model's self-calibrated effective constants.
+        "native": {},
         # Query flight recorder (spark_rapids_tpu/monitoring/): one
         # TRACED q3 run after the timing loop — the span-category wall
         # breakdown (queued/host-prefetch/device-compute/upload/
@@ -698,6 +703,14 @@ def main():
         plc["entries"] = _plc.cache().stats()["entries"]
         plc["enabled"] = _plc.plan_cache_enabled(_C.TpuConf())
         out["plan_cache"] = plc
+        from spark_rapids_tpu.ops import native as _native
+        nt = _native.counters()
+        for name in ("nativeRadixSortTraces", "nativeJoinProbeTraces",
+                     "nativeRleDecodeTraces",
+                     "nativeSegmentReduceTraces"):
+            nt.setdefault(name, 0)
+        nt["calibration"] = _cost.calibration_state()
+        out["native"] = nt
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

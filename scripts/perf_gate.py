@@ -1,0 +1,230 @@
+"""CI perf-regression gate: replay the warmup manifest and fail on >15%
+regression of the headline counters vs the committed reference.
+
+The gate measures three headline numbers (ROADMAP item 1's "lock it in"):
+
+- ``compile_s``     — wall clock of replaying the ``scripts/warmup.py``
+                      shape manifest with NO persistent kernel cache
+                      (every kernel traces + compiles fresh): the
+                      cold-compile cliff a fresh server pays.
+- ``bind_only_ms``  — median latency of a repeat parameterized query
+                      through the plan cache (zero re-plan, zero
+                      re-trace): the steady-state serving floor.
+- ``scan_gbps``     — post-compile cold-scan throughput of q1+q6
+                      through the ingest fast path (the same probe that
+                      produces bench.py's scan_gb_per_sec headline).
+
+Machine normalization: absolute wall clock is meaningless across CI
+runners, so the gate first times a fixed numpy calibration workload and
+scales every latency by ``ref_calib_s / my_calib_s`` (and throughput by
+the inverse) before comparing. The committed reference
+(``PERF_REFERENCE.json``) stores its own calibration time for exactly
+this purpose. The tolerance is 15% after normalization
+(``PERF_GATE_TOLERANCE`` overrides; CI runners are noisy — loosen there
+rather than deleting the gate).
+
+Usage::
+
+    python scripts/perf_gate.py              # compare vs PERF_REFERENCE.json
+    python scripts/perf_gate.py --update     # re-measure and commit as ref
+
+Prints one JSON line with measured / normalized / reference values and
+per-metric verdicts; exits 1 on any regression beyond tolerance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_REFERENCE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "PERF_REFERENCE.json")
+
+
+def calibration_s(iters: int = 3) -> float:
+    """Fixed numpy workload timing this machine's single-core speed —
+    the normalization denominator. Matmul + memcpy + sort roughly
+    mirror the engine's host-side mix."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(384, 384))
+    buf = rng.normal(size=1 << 20)
+    keys = rng.integers(0, 1 << 31, 1 << 19)
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(8):
+            _ = a @ a
+        for _ in range(16):
+            _ = buf.copy() * 1.5
+        _ = np.sort(keys, kind="stable")
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_compile_s() -> dict:
+    """Replay the warmup shape manifest with the persistent cache OFF:
+    pure trace+compile wall clock."""
+    from scripts import warmup
+    from spark_rapids_tpu.ops import kernel_cache as kc
+    kc.cache().clear()
+    buf = io.StringIO()
+    t0 = time.perf_counter()
+    with contextlib.redirect_stdout(buf):
+        rc = warmup.main(["--persistent-dir", ""])
+    secs = time.perf_counter() - t0
+    report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    if rc != 0:
+        raise RuntimeError(f"warmup replay failed: {report['shapes']}")
+    return {"compile_s": round(secs, 3),
+            "kernel_compiles": report["kernel_compiles"],
+            "shapes": len(report["shapes"])}
+
+
+def measure_bind_only_ms(iters: int = 7) -> float:
+    """Median collect latency of a repeat parameterized q6-class query:
+    a plan-cache hit executing bind-only."""
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import tpch
+    sf = float(os.environ.get("WARMUP_SF", "0.01"))
+    tpch_dir = os.environ.get("TPCH_DIR", f"/tmp/srt_tpch_sf{sf:g}")
+    if not os.path.isdir(tpch_dir):
+        tpch.generate(tpch_dir, scale=sf)
+
+    def session():
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        s.set("spark.rapids.sql.hasNans", False)
+        return s
+
+    df = tpch.QUERIES["q6"](session(), tpch_dir)
+    df.collect()                        # compile + template into the cache
+    samples = []
+    for _ in range(iters):
+        # A fresh DataFrame each round so the plan-CACHE (not the same
+        # object) serves the template; same literals = same key.
+        df = tpch.QUERIES["q6"](session(), tpch_dir)
+        t0 = time.perf_counter()
+        df.collect()
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(samples)
+
+
+def measure_scan_gbps() -> float:
+    """Post-compile cold-scan throughput of q1+q6 (bench.py's
+    scan_gb_per_sec probe at gate scale)."""
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.io.scan import DEVICE_SCAN_CACHE
+    sf = float(os.environ.get("WARMUP_SF", "0.01"))
+    tpch_dir = os.environ.get("TPCH_DIR", f"/tmp/srt_tpch_sf{sf:g}")
+    if not os.path.isdir(tpch_dir):
+        tpch.generate(tpch_dir, scale=sf)
+
+    def session():
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        s.set("spark.rapids.sql.hasNans", False)
+        return s
+
+    dfs = [tpch.QUERIES[q](session(), tpch_dir) for q in ("q1", "q6")]
+    for df in dfs:
+        df.collect()
+    DEVICE_SCAN_CACHE.clear()
+    t0 = time.perf_counter()
+    for df in dfs:
+        df.collect()
+    secs = time.perf_counter() - t0
+    nbytes = tpch.bytes_scanned("q1", tpch_dir) + \
+        tpch.bytes_scanned("q6", tpch_dir)
+    return nbytes / secs / 1e9 if secs > 0 else 0.0
+
+
+def measure() -> dict:
+    calib = calibration_s()
+    out = {"calibration_s": round(calib, 4)}
+    out.update(measure_compile_s())
+    out["bind_only_ms"] = round(measure_bind_only_ms(), 3)
+    out["scan_gbps"] = round(measure_scan_gbps(), 4)
+    return out
+
+
+# metric -> direction ("lower" = regression when it grows)
+GATED = {"compile_s": "lower", "bind_only_ms": "lower",
+         "scan_gbps": "higher"}
+
+
+def compare(measured: dict, reference: dict, tolerance: float) -> dict:
+    """Normalize by the calibration ratio and verdict each metric."""
+    speed = reference["calibration_s"] / max(measured["calibration_s"],
+                                             1e-9)
+    report = {"speed_ratio": round(speed, 4), "tolerance": tolerance,
+              "metrics": {}, "ok": True}
+    for name, direction in GATED.items():
+        raw = measured[name]
+        ref = reference[name]
+        # A machine twice as slow (speed < 1) gets its latencies scaled
+        # DOWN (and throughput scaled UP) before the comparison.
+        norm = raw * speed if direction == "lower" else raw / speed
+        if direction == "lower":
+            ok = norm <= ref * (1.0 + tolerance)
+            delta = norm / ref - 1.0 if ref else 0.0
+        else:
+            ok = norm >= ref * (1.0 - tolerance)
+            delta = 1.0 - norm / ref if ref else 0.0
+        report["metrics"][name] = {
+            "measured": raw, "normalized": round(norm, 4),
+            "reference": ref, "regressionPct": round(delta * 100, 1),
+            "ok": ok}
+        report["ok"] = report["ok"] and ok
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--reference", default=DEFAULT_REFERENCE)
+    ap.add_argument("--update", action="store_true",
+                    help="re-measure and write the reference file")
+    args = ap.parse_args(argv)
+    tolerance = float(os.environ.get("PERF_GATE_TOLERANCE", "0.15"))
+    # Pin the DEVICE path: at gate scale the cost model would host-place
+    # every query and the gate would measure the host engine instead of
+    # compile/bind/scan. (Set before any collect adopts the conf.)
+    os.environ.setdefault("SRT_COST", "0")
+
+    measured = measure()
+    if args.update:
+        measured["note"] = (
+            "Committed perf-gate reference (scripts/perf_gate.py "
+            "--update). calibration_s normalizes across machines.")
+        with open(args.reference, "w") as f:
+            json.dump(measured, f, indent=2, sort_keys=True)
+            f.write("\n")
+        sys.stdout.write(json.dumps({"updated": args.reference,
+                                     **measured}) + "\n")
+        return 0
+    with open(args.reference) as f:
+        reference = json.load(f)
+    report = compare(measured, reference, tolerance)
+    sys.stdout.write(json.dumps(report) + "\n")
+    if not report["ok"]:
+        bad = [n for n, m in report["metrics"].items() if not m["ok"]]
+        sys.stderr.write(
+            f"PERF GATE FAILED: {bad} regressed beyond "
+            f"{tolerance:.0%} (normalized vs {args.reference})\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -714,6 +714,79 @@ SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS = conf(
     "manifests before failing with a lost-shard error (which flows "
     "into the recovery ladder).").integer(30000)
 
+NATIVE_ENABLED = conf("spark.rapids.sql.native.enabled").doc(
+    "Native Pallas kernel layer (ops/native.py): re-implement the "
+    "profiled top device-time sinks — the LSD radix sort's per-digit "
+    "passes, the hash-join probe's double binary search, wire v2's RLE "
+    "decode, and the sorted-segment groupby reductions — as TPU-native "
+    "Pallas (Mosaic) kernels instead of jax.numpy compositions, the "
+    "analog of the reference routing every kernel through libcudf "
+    "(PAPER.md L0). Every native kernel is bit-identical to its "
+    "jax.numpy twin (the parity suite pins this) and individually "
+    "gateable via the spark.rapids.sql.native.<kernel>.enabled keys; "
+    "false restores today's jax.numpy code paths byte-for-byte. "
+    "Kernels engage only on a real TPU backend — CPU runs no-op to the "
+    "fallback (SRT_NATIVE_INTERPRET=1 forces the Pallas interpreter for "
+    "the CPU parity suite). The SRT_NATIVE env (0/1) overrides the "
+    "default for a whole process.").boolean(True)
+
+NATIVE_RADIX_SORT = conf("spark.rapids.sql.native.radixSort.enabled").doc(
+    "Per-kernel gate: native LSD radix rank for the stable u32 sort "
+    "passes every multi-pass sort shares (ops/kernels.py _radix_perm) — "
+    "an 8-bit counting-sort rank (block histogram + scanned bases + "
+    "stable within-block prefix) replacing XLA's O(n log^2 n) bitonic "
+    "argsort per pass. Stable by construction, so the permutation is "
+    "bit-identical.").boolean(True)
+
+NATIVE_JOIN_PROBE = conf("spark.rapids.sql.native.joinProbe.enabled").doc(
+    "Per-kernel gate: native hash-join probe (ops/join.py "
+    "probe_ranges) — one fused branchless lower/upper binary search "
+    "over the sorted build fingerprints (uint64 as two u32 planes, "
+    "lexicographic compare) instead of two jnp.searchsorted "
+    "dispatches.").boolean(True)
+
+NATIVE_RLE_DECODE = conf("spark.rapids.sql.native.rleDecode.enabled").doc(
+    "Per-kernel gate: native wire-v2 RLE decode (columnar/wire.py) — "
+    "one interval-membership select over the run table instead of the "
+    "searchsorted+gather chain, engaged when the run table fits "
+    "native.rleDecode.maxRuns. Values move as bit patterns (int "
+    "planes), so the decode stays bit-exact including -0.0/NaN float "
+    "payloads.").boolean(True)
+
+NATIVE_RLE_MAX_RUNS = conf("spark.rapids.sql.native.rleDecode.maxRuns").doc(
+    "Run-table bound for the native RLE decode: a column whose run "
+    "capacity exceeds this falls back to the jax.numpy "
+    "searchsorted+gather decode (the interval select is O(rows x "
+    "runs)).").integer(4096)
+
+NATIVE_SEGMENT_REDUCE = conf(
+    "spark.rapids.sql.native.segmentReduce.enabled").doc(
+    "Per-kernel gate: native sorted-segment reduction (ops/kernels.py "
+    "segment_reduce) — a single-sweep segmented scan (Hillis-Steele "
+    "within blocks, a sequential-grid carry across them) replacing the "
+    "scatter-based jax.ops.segment_* for group-sorted ids. Engages for "
+    "integer/count sums (exact two's-complement, carried as u32 "
+    "planes) and min/max in the total-order bit domain (so -0.0 < 0.0 "
+    "and identities match the twin exactly); float SUMS stay on the "
+    "jax.numpy twin — reduction order changes float rounding, and "
+    "bit-identity is the contract.").boolean(True)
+
+COST_CALIBRATION = conf("spark.rapids.sql.cost.calibration.enabled").doc(
+    "Cost-model self-calibration (plan/cost.py): feed flight-recorder "
+    "span timings (sync-category span means -> deviceSyncFloorMs, "
+    "upload span bytes/wall -> deviceThroughputGBps) and the "
+    "Cost@query estimateErrorPct back into the placement model as "
+    "EWMA-updated effective constants, clamped to [1/4x, 4x] of the "
+    "configured values — so placement tracks the machine it runs on "
+    "instead of hand constants. An explicitly-set cost.* key always "
+    "wins over the calibrated value. The SRT_COST_CALIBRATION env "
+    "(0/1) overrides the default.").boolean(True)
+
+COST_CALIBRATION_ALPHA = conf(
+    "spark.rapids.sql.cost.calibration.alpha").doc(
+    "EWMA weight of one query's observation when calibrating "
+    "cost.{deviceSyncFloorMs,deviceThroughputGBps}.").double(0.2)
+
 PLAN_CACHE_ENABLED = conf("spark.rapids.sql.planCache.enabled").doc(
     "Parameterized plan cache (plan/plan_cache.py): keep fully "
     "planned/fused/cost-placed physical plan templates in a "
@@ -1071,6 +1144,43 @@ def generate_docs() -> str:
         "Disabled, the recorder is a shared no-op costing nanoseconds",
         "per call site — results and metrics are byte-identical either",
         "way. See docs/observability.md.",
+        "",
+        "## Native Pallas kernels",
+        "",
+        "With `spark.rapids.sql.native.enabled` (default true) the hot",
+        "device loops the flight recorder profiles as the top",
+        "device-time sinks run as TPU-native Pallas (Mosaic) kernels",
+        "instead of jax.numpy compositions — the analog of the",
+        "reference routing every kernel through libcudf:",
+        "",
+        "- `native.radixSort.enabled` — stable u32 radix rank for every",
+        "  LSD sort pass (`ops/kernels.py _radix_perm`): block",
+        "  histograms + scanned digit bases + a stable within-block",
+        "  prefix, 4 counting passes per word instead of an XLA",
+        "  bitonic argsort.",
+        "- `native.joinProbe.enabled` — the hash-join probe's double",
+        "  binary search (`ops/join.py probe_ranges`) fused into one",
+        "  branchless lower/upper search over two u32 planes.",
+        "- `native.rleDecode.enabled` — wire v2's RLE decode as an",
+        "  interval-membership select over the run table (bounded by",
+        "  `native.rleDecode.maxRuns`), bit patterns only.",
+        "- `native.segmentReduce.enabled` — sorted-segment groupby",
+        "  reductions as a single-sweep segmented scan (integer/count",
+        "  sums exactly in u32 carry planes; min/max in the total-order",
+        "  bit domain; float sums stay on the twin because reduction",
+        "  order changes float rounding).",
+        "",
+        "Every native kernel keeps its jax.numpy twin as a per-op",
+        "kill-switch fallback and is BIT-IDENTICAL to it (the",
+        "tests/test_native.py parity suite pins the whole dtype ladder",
+        "including -0.0/NaN); `native.enabled=false` (or `SRT_NATIVE=0`)",
+        "restores today's code paths byte-for-byte. Kernels engage only",
+        "on a real TPU backend — CPU runs no-op to the fallback, and",
+        "`SRT_NATIVE_INTERPRET=1` forces the Pallas interpreter so the",
+        "CPU CI can prove parity. `scripts/microbench.py` compares each",
+        "native kernel against its twin (the >=2x-on-TPU claim);",
+        "bench.py's `native` JSON block reports the enabled set and",
+        "trace counts. See docs/performance.md.",
         "",
         "## Dynamic per-rule kill switches",
         "",

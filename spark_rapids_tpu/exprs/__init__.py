@@ -35,7 +35,7 @@ from spark_rapids_tpu.exprs.strings import (      # noqa: F401
     StringLPad, StringRepeat, StringReplace, StringReverse, StringRPad,
     StringSplit, StringTrim, StringTrimLeft, StringTrimRight, Substring,
     SubstringIndex, Translate, Upper)
-from spark_rapids_tpu.exprs.hash import Murmur3Hash  # noqa: F401
+from spark_rapids_tpu.exprs.hash import Md5, Murmur3Hash  # noqa: F401
 from spark_rapids_tpu.exprs.nondeterministic import (  # noqa: F401
     EvalContext, InputFileName, MonotonicallyIncreasingID, Rand,
     SparkPartitionID, eval_context, needs_eval_context)

@@ -188,6 +188,140 @@ def hash_column(xp, col, dtype: DataType, seed_u32):
     return xp.where(col.validity, h, seed_u32)
 
 
+# ---------------------------------------------------------------------------
+# MD5 (Spark's Md5 expression: md5(binary) -> 32-char lowercase hex string)
+# ---------------------------------------------------------------------------
+#
+# RFC 1321 vectorized in uint32 lane arithmetic over the (N, W) byte
+# matrix, same xp polymorphism as murmur3 above so the device (jnp) and
+# host (np) paths share one implementation. Per-row message lengths vary,
+# so padding (0x80 terminator + little-endian bit length) is injected
+# positionally with where-selects, and chunks beyond a row's padded
+# length leave its state untouched. All loops are over the STATIC width,
+# so XLA unrolls and fuses them.
+
+import math as _math
+
+_MD5_K = tuple(int(abs(_math.sin(i + 1)) * (1 << 32)) & 0xFFFFFFFF
+               for i in range(64))
+_MD5_S = (7, 12, 17, 22) * 4 + (5, 9, 14, 20) * 4 + \
+    (4, 11, 16, 23) * 4 + (6, 10, 15, 21) * 4
+_MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+
+def md5_hex_matrix(xp, data, lengths):
+    """MD5 of each row of a (N, W) byte matrix (first ``lengths[i]``
+    bytes), as an (N, 32) lowercase-hex byte matrix."""
+    n, w = data.shape
+    lengths = lengths.astype(np.int32)
+    # Padded byte stream: message | 0x80 | zeros | 8-byte LE bit length.
+    max_chunks = (w + 8) // 64 + 1
+    total = max_chunks * 64
+    padded_len = ((lengths + 8) // 64 + 1) * 64
+    bitlen = lengths.astype(np.uint32) * np.uint32(8)
+    row_chunks = padded_len // 64
+
+    def byte_at(o: int):
+        """(N,) uint32 byte o of each row's padded stream."""
+        msg = data[:, o].astype(np.uint32) if o < w else np.uint32(0)
+        b = xp.where(o < lengths, msg, np.uint32(0))
+        b = xp.where(o == lengths, np.uint32(0x80), b)
+        # Little-endian 64-bit bit count in the trailing 8 bytes; the
+        # high 4 bytes are always zero (lengths are far below 2^29).
+        k = o - (padded_len - 8)
+        in_len = (k >= 0) & (k < 4)
+        k_safe = xp.where(in_len, k, 0).astype(np.uint32)
+        lb = xp.where(in_len,
+                      (bitlen >> (k_safe * np.uint32(8))) & np.uint32(0xFF),
+                      np.uint32(0))
+        return b | lb
+
+    a, b, c, d = (xp.full((n,), np.uint32(v), dtype=np.uint32)
+                  for v in _MD5_INIT)
+    for chunk in range(max_chunks):
+        m = []
+        for j in range(16):
+            o = chunk * 64 + j * 4
+            word = byte_at(o) | (byte_at(o + 1) << np.uint32(8)) | \
+                (byte_at(o + 2) << np.uint32(16)) | \
+                (byte_at(o + 3) << np.uint32(24))
+            m.append(word)
+        A, B, C, D = a, b, c, d
+        for i in range(64):
+            if i < 16:
+                f = (B & C) | (~B & D)
+                g = i
+            elif i < 32:
+                f = (D & B) | (~D & C)
+                g = (5 * i + 1) % 16
+            elif i < 48:
+                f = B ^ C ^ D
+                g = (3 * i + 5) % 16
+            else:
+                f = C ^ (B | ~D)
+                g = (7 * i) % 16
+            f = f + A + np.uint32(_MD5_K[i]) + m[g]
+            A = D
+            D = C
+            C = B
+            B = B + _rotl(xp, f, _MD5_S[i])
+        live = chunk < row_chunks
+        a = xp.where(live, a + A, a)
+        b = xp.where(live, b + B, b)
+        c = xp.where(live, c + C, c)
+        d = xp.where(live, d + D, d)
+    # Digest = a|b|c|d little-endian -> 32 lowercase hex chars.
+    out = []
+    for word in (a, b, c, d):
+        for byte_i in range(4):
+            byte = (word >> np.uint32(8 * byte_i)) & np.uint32(0xFF)
+            for nib_shift in (4, 0):
+                nib = (byte >> np.uint32(nib_shift)) & np.uint32(0xF)
+                out.append(xp.where(nib < 10, nib + np.uint32(48),
+                                    nib + np.uint32(87)).astype(np.uint8))
+    return xp.stack(out, axis=1)
+
+
+class Md5(Expression):
+    """md5(string) -> 32-char lowercase hex string (Spark Md5 over the
+    UTF-8 bytes; NULL in, NULL out)."""
+
+    def __init__(self, child: Expression):
+        self._children = (child,)
+
+    @property
+    def children(self):
+        return self._children
+
+    def data_type(self) -> DataType:
+        return dt.STRING
+
+    def eval(self, batch):
+        child = self._children[0]
+        assert child.data_type().is_string, "md5 expects a string column"
+        col = as_device_column(child.eval(batch), batch)
+        hexm = md5_hex_matrix(jnp, col.data, col.lengths)
+        validity = col.validity & batch.row_mask()
+        lengths = jnp.where(validity, jnp.int32(32), jnp.int32(0))
+        return make_column(dt.STRING, hexm, validity, lengths)
+
+    def eval_host(self, batch):
+        import hashlib
+        child = self._children[0]
+        hc = as_host_column(child.eval_host(batch), batch)
+        n = batch.num_rows
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if hc.validity[i]:
+                v = hc.data[i]
+                raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                out[i] = hashlib.md5(raw).hexdigest().encode("ascii")
+            else:
+                out[i] = b""
+        return make_host_column(dt.STRING, out,
+                                np.asarray(hc.validity, np.bool_))
+
+
 class Murmur3Hash(Expression):
     """hash(c1, c2, ...) -> int32, seed chained across columns."""
 

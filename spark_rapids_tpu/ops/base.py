@@ -514,10 +514,14 @@ class Exec:
             from spark_rapids_tpu.memory.stores import get_tpu_semaphore
             # Adopt this query's wire codec selection (process-global,
             # spark.rapids.sql.wire.codec) before any upload happens —
-            # and its flight-recorder configuration, before any span
-            # site runs (spark.rapids.sql.trace.*).
+            # its flight-recorder configuration, before any span
+            # site runs (spark.rapids.sql.trace.*) — and its native
+            # Pallas kernel gates, before any kernel traces
+            # (spark.rapids.sql.native.*).
+            from spark_rapids_tpu.ops import native
             wire.maybe_configure(ctx.conf)
             monitoring.maybe_configure(ctx.conf)
+            native.maybe_configure(ctx.conf)
             # Task admission (GpuSemaphore.scala:74-87): at most
             # concurrentTpuTasks collects issue device work at once, so
             # concurrent queries can't oversubscribe HBM.
@@ -621,6 +625,16 @@ class Exec:
                     rows.extend(hb.to_pylist())
             finally:
                 collect_span.__exit__(None, None, None)
+            # Cost-model self-calibration: feed this query's observed
+            # sync-span mean and upload throughput (plus the Cost@query
+            # estimateErrorPct as a trust dampener) back into the
+            # placement model's effective constants (plan/cost.py). A
+            # no-op when tracing is off or calibration is disabled.
+            try:
+                from spark_rapids_tpu.plan import cost as COST
+                COST.observe_query(ctx)
+            except Exception:   # calibration must never fail a query
+                pass
         else:
             from spark_rapids_tpu import monitoring
             monitoring.maybe_configure(ctx.conf)

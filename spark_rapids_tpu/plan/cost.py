@@ -64,6 +64,141 @@ def reset_counters() -> None:
         _COUNTERS.clear()
 
 
+# ---------------------------------------------------------------------------
+# Self-calibration: the model's two machine constants — the per-dispatch
+# sync floor and the device pipeline throughput — default to hand
+# calibrations of one round-5 chip. With cost.calibration.enabled the
+# flight recorder's observed numbers EWMA into process-global effective
+# values (clamped to [1/4x, 4x] of the configured constants), so
+# placement tracks the machine it actually runs on. An explicitly-set
+# cost.* conf key always wins over the calibrated value.
+# ---------------------------------------------------------------------------
+
+_CAL_LOCK = threading.Lock()
+_CAL: Dict[str, Optional[float]] = {
+    "sync_floor_ms": None, "device_gbps": None, "samples": 0.0,
+    "last_error_pct": None}
+
+
+def calibration_enabled(conf: "C.TpuConf") -> bool:
+    if conf.raw.get(C.COST_CALIBRATION.key) is not None:
+        return bool(conf.get(C.COST_CALIBRATION))
+    env = os.environ.get("SRT_COST_CALIBRATION")
+    if env is not None:
+        return env.strip() not in ("0", "false", "no")
+    return bool(C.COST_CALIBRATION.default)
+
+
+def _clamped(value: float, default: float) -> float:
+    return min(max(value, default / 4.0), default * 4.0)
+
+
+def effective_sync_floor_ms(conf: "C.TpuConf") -> float:
+    """The sync floor the estimator charges: an explicit conf key wins;
+    else the calibrated observation (clamped); else the default."""
+    configured = float(conf.get(C.COST_SYNC_FLOOR_MS))
+    if conf.raw.get(C.COST_SYNC_FLOOR_MS.key) is not None or \
+            not calibration_enabled(conf):
+        return configured
+    with _CAL_LOCK:
+        cal = _CAL["sync_floor_ms"]
+    return configured if cal is None else _clamped(cal, configured)
+
+
+def effective_device_gbps(conf: "C.TpuConf") -> float:
+    configured = float(conf.get(C.COST_DEVICE_GBPS))
+    if conf.raw.get(C.COST_DEVICE_GBPS.key) is not None or \
+            not calibration_enabled(conf):
+        return configured
+    with _CAL_LOCK:
+        cal = _CAL["device_gbps"]
+    return configured if cal is None else _clamped(cal, configured)
+
+
+def observe(sync_floor_ms: Optional[float] = None,
+            device_gbps: Optional[float] = None,
+            error_pct: Optional[float] = None,
+            alpha: float = 0.2) -> None:
+    """Fold one query's observations into the calibration state.
+    ``error_pct`` (the Cost@query estimateErrorPct) dampens the update:
+    a query whose byte estimates were far off earns less trust."""
+    weight = alpha
+    if error_pct is not None:
+        weight = alpha / (1.0 + max(error_pct, 0.0) / 100.0)
+    with _CAL_LOCK:
+        if error_pct is not None:
+            _CAL["last_error_pct"] = float(error_pct)
+        for key, obs in (("sync_floor_ms", sync_floor_ms),
+                         ("device_gbps", device_gbps)):
+            if obs is None or obs <= 0:
+                continue
+            cur = _CAL[key]
+            _CAL[key] = float(obs) if cur is None \
+                else (1.0 - weight) * cur + weight * float(obs)
+        if sync_floor_ms is not None or device_gbps is not None:
+            _CAL["samples"] += 1
+    _record("costCalibrationUpdates")
+
+
+def calibration_state() -> Dict[str, Optional[float]]:
+    with _CAL_LOCK:
+        return dict(_CAL)
+
+
+def reset_calibration() -> None:
+    with _CAL_LOCK:
+        _CAL.update({"sync_floor_ms": None, "device_gbps": None,
+                     "samples": 0.0, "last_error_pct": None})
+
+
+def observe_query(ctx) -> None:
+    """Feed one finished query's flight-recorder spans (and its
+    Cost@query estimateErrorPct) back into the calibration state.
+    Called from the collect tail; a no-op when tracing is off (no spans
+    to learn from) or calibration is disabled."""
+    if not calibration_enabled(ctx.conf):
+        return
+    from spark_rapids_tpu import monitoring
+    if not monitoring.enabled():
+        return
+    qid = ctx.cache.get("trace_query")
+    if qid is None:
+        return
+    evs = monitoring.events(qid)
+    sync_ns: List[float] = []
+    upload_bytes = 0.0
+    upload_ns = 0.0
+    for e in evs:
+        if e[0] != "X":
+            continue
+        cat, dur = e[2], e[4]
+        if cat == "sync":
+            sync_ns.append(dur)
+        elif cat == "upload":
+            args = e[7] or {}
+            b = args.get("bytes")
+            if b:
+                upload_bytes += float(b)
+                upload_ns += float(dur)
+    sync_floor = (sum(sync_ns) / len(sync_ns)) / 1e6 if sync_ns else None
+    gbps = (upload_bytes / (upload_ns / 1e9)) / 1e9 \
+        if upload_ns > 0 and upload_bytes > 0 else None
+    err = None
+    try:
+        # Read-only: query_metrics_entry would CREATE an empty
+        # Cost@query group and change the query's metric shape.
+        cm = ctx.metrics.get("Cost@query")
+        if cm is not None:
+            err = cm.values.get("estimateErrorPct")
+    except Exception:
+        pass
+    if sync_floor is None and gbps is None:
+        return
+    alpha = float(ctx.conf.get(C.COST_CALIBRATION_ALPHA))
+    observe(sync_floor_ms=sync_floor, device_gbps=gbps, error_pct=err,
+            alpha=alpha)
+
+
 def cost_enabled(conf: "C.TpuConf") -> bool:
     """Conf key wins; else the SRT_COST env (CI matrix hook); else the
     registered default."""
@@ -182,8 +317,8 @@ def estimate_plan(plan: LogicalPlan, conf: "C.TpuConf",
     if isinstance(plan, L.LogicalAggregate) and plan.grouping is not None:
         nk = len(plan.group_by)
         mult = (nk + 1) if plan.grouping == "rollup" else (1 << nk)
-    sync_ms = float(conf.get(C.COST_SYNC_FLOOR_MS))
-    dev_bw = max(float(conf.get(C.COST_DEVICE_GBPS)), 1e-3) * 1e9 / 1e3
+    sync_ms = effective_sync_floor_ms(conf)
+    dev_bw = max(effective_device_gbps(conf), 1e-3) * 1e9 / 1e3
     host_bw = max(float(conf.get(C.COST_HOST_GBPS)), 1e-3) * 1e9 / 1e3
     syncs = _node_syncs(plan, conf)
     if bytes_in is None:

@@ -244,6 +244,12 @@ def murmur3_hash(*cs):
     return Column(("hash", tuple(_as_col(c) for c in cs)))
 
 
+def md5(c) -> Column:
+    """MD5 of a string column's UTF-8 bytes as a 32-char lowercase
+    hex string (Spark Md5; NULL in, NULL out)."""
+    return Column(("md5", _as_col(c)))
+
+
 def concat_ws(sep: str, *cs) -> Column:
     return Column(("concat_ws", sep, tuple(_as_col(c) for c in cs)))
 
@@ -730,6 +736,8 @@ def resolve(c: Column, schema: Schema) -> Expression:
         return E.Round(rec(node[1]), node[2])
     if kind == "hash":
         return E.Murmur3Hash([rec(x) for x in node[1]])
+    if kind == "md5":
+        return E.Md5(rec(node[1]))
     if kind == "bround":
         return E.BRound(rec(node[1]), node[2])
     if kind == "concat_ws":

@@ -124,3 +124,14 @@ def _trace_ring_isolation():
     yield
     from spark_rapids_tpu import monitoring
     monitoring.reset()
+
+
+@pytest.fixture(autouse=True)
+def _cost_calibration_isolation():
+    """Reset the cost model's self-calibration state after every test: a
+    traced collect feeds observed sync/throughput numbers into
+    process-global effective constants (plan/cost.py observe_query),
+    which must never skew a later test's placement assertions."""
+    yield
+    from spark_rapids_tpu.plan import cost
+    cost.reset_calibration()

@@ -271,3 +271,86 @@ def test_suites_parity_cost_on_vs_off(qname, tmp_path_factory):
         "spark.rapids.sql.cost.enabled": False}), d).collect()
     from spark_rapids_tpu.benchmarks.compare import compare_results
     assert compare_results(on, off, sort=True), qname
+
+
+class TestCalibration:
+    """Cost-model self-calibration (ISSUE 11 satellite): observed sync
+    floors / throughput EWMA into effective constants, clamped, with
+    explicit conf keys always winning."""
+
+    def setup_method(self):
+        from spark_rapids_tpu.plan import cost
+        cost.reset_calibration()
+
+    def teardown_method(self):
+        from spark_rapids_tpu.plan import cost
+        cost.reset_calibration()
+
+    def _conf(self, **raw):
+        from spark_rapids_tpu.config import TpuConf
+        return TpuConf(dict(raw))
+
+    def test_observation_moves_effective_values(self):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.plan import cost
+        conf = self._conf()
+        base = float(C.COST_SYNC_FLOOR_MS.default)
+        assert cost.effective_sync_floor_ms(conf) == base
+        cost.observe(sync_floor_ms=base / 2, device_gbps=4.0)
+        assert cost.effective_sync_floor_ms(conf) == base / 2
+        assert cost.effective_device_gbps(conf) == 4.0
+        # EWMA: a second observation blends, not replaces.
+        cost.observe(sync_floor_ms=base, alpha=0.5)
+        eff = cost.effective_sync_floor_ms(conf)
+        assert base / 2 < eff < base
+
+    def test_clamped_to_4x_band(self):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.plan import cost
+        conf = self._conf()
+        base = float(C.COST_SYNC_FLOOR_MS.default)
+        cost.observe(sync_floor_ms=base * 1000)
+        assert cost.effective_sync_floor_ms(conf) == base * 4
+        cost.reset_calibration()
+        cost.observe(sync_floor_ms=base / 1000)
+        assert cost.effective_sync_floor_ms(conf) == base / 4
+
+    def test_explicit_conf_key_wins(self):
+        from spark_rapids_tpu.plan import cost
+        conf = self._conf(**{"spark.rapids.sql.cost.deviceSyncFloorMs":
+                             33.0})
+        cost.observe(sync_floor_ms=5.0)
+        assert cost.effective_sync_floor_ms(conf) == 33.0
+
+    def test_disabled_leaves_constants(self):
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.plan import cost
+        conf = self._conf(**{"spark.rapids.sql.cost.calibration.enabled":
+                             False})
+        cost.observe(sync_floor_ms=1.0)
+        assert cost.effective_sync_floor_ms(conf) == \
+            float(C.COST_SYNC_FLOOR_MS.default)
+
+    def test_error_pct_dampens_update(self):
+        from spark_rapids_tpu.plan import cost
+        cost.observe(sync_floor_ms=100.0)
+        cost.observe(sync_floor_ms=10.0, error_pct=400.0, alpha=0.5)
+        # weight = 0.5/(1+4) = 0.1 -> 0.9*100 + 0.1*10 = 91
+        assert abs(cost.calibration_state()["sync_floor_ms"] - 91.0) < 1e-9
+
+    def test_observe_query_reads_trace_spans(self, tmp_path):
+        """A traced collect feeds real sync/upload spans into the
+        calibration state."""
+        from spark_rapids_tpu.plan import cost
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        from spark_rapids_tpu.benchmarks import tpch
+        d = str(tmp_path / "cal_tpch")
+        tpch.generate(d, scale=0.003, files_per_table=1, seed=7)
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        s.set("spark.rapids.sql.trace.enabled", True)
+        s.set("spark.rapids.sql.trace.level", "kernel")
+        tpch.QUERIES["q6"](s, d).collect()
+        state = cost.calibration_state()
+        assert state["samples"] >= 1, state
+        assert (state["sync_floor_ms"] or state["device_gbps"]), state

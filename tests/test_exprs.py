@@ -828,3 +828,40 @@ class TestSplitSubstringIndex:
             substring_index(col("s"), ".", 2).alias("prefix")).collect()
         assert out == [("b", "a.b"), (None, "x"), (None, None),
                        ("q", "p.q")]
+
+
+class TestMd5:
+    """Md5 (VERDICT row 8 expression-gap remainder): the vectorized
+    device/host MD5 against hashlib over data_gen strings, including
+    every padding boundary (55/56/64-byte chunk edges)."""
+
+    @staticmethod
+    def _oracle(vals):
+        import hashlib
+        return [None if v is None
+                else hashlib.md5(v.encode("utf-8")).hexdigest()
+                for v in vals]
+
+    def test_md5_padding_boundaries(self):
+        vals = ["", "abc", "a" * 54, "b" * 55, "c" * 56, "d" * 63,
+                "e" * 64, "f" * 65, None, "g" * 119, "h" * 120]
+        b = make_batch([("s", dt.STRING)], {"s": vals})
+        check_expr(E.Md5(Ref(0, dt.STRING)), b, self._oracle(vals))
+
+    def test_md5_data_gen_parity(self):
+        from data_gen import StringGen
+        rng = np.random.default_rng(42)
+        vals = StringGen(nullable=True).gen(rng, 96)
+        b = make_batch([("s", dt.STRING)], {"s": vals})
+        check_expr(E.Md5(Ref(0, dt.STRING)), b, self._oracle(vals))
+
+    def test_md5_dataframe_api(self):
+        from spark_rapids_tpu.api.dataframe import TpuSession
+        from spark_rapids_tpu.plan.logical import col, md5
+        s = TpuSession()
+        df = s.create_dataframe({"s": ["hello", None, ""]},
+                                [("s", dt.STRING)])
+        out = df.select(md5(col("s")).alias("h")).collect()
+        import hashlib
+        assert out == [(hashlib.md5(b"hello").hexdigest(),), (None,),
+                       (hashlib.md5(b"").hexdigest(),)]
