@@ -395,6 +395,81 @@ def _concurrency_probe(tpch_dir: str, n: int) -> dict:
     }
 
 
+def _distributed_probe(tpch_dir: str) -> dict:
+    """Distributed worker runtime (parallel/cluster/): shuffle-forced q3
+    dispatched through the stage-task coordinator at 1 vs 2 vs 3 worker
+    processes, checked bit-identical against the same-conf local run.
+
+    Interpreting the numbers requires ``host_cpus``: extra co-located
+    worker processes can only overlap stage compute when there are
+    spare cores to run them on. With host_cpus >= workers the leaf
+    scans overlap and speedup_3v1 should exceed 1; on a single-core
+    host the block instead measures the *overhead* of distribution
+    (cross-process shard hops, poll gaps, steal-delay waits), and
+    multi-worker parity with workers_1 is the best possible result.
+    The multi-host speedup story is measured on real TPU pods, not
+    here (ROADMAP item 2).
+
+    Each configuration warms to steady state first: the cold run's
+    multi-second kernel traces outlive the steal-delay reservation, so
+    placement only settles once every worker has compiled its stages —
+    up to one compile wave per worker, hence n+1 warm runs."""
+    import subprocess
+
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.parallel import cluster as CL
+
+    def q3_session(n=None):
+        s = _session()
+        s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        if n is not None:
+            s.set("spark.rapids.sql.cluster.enabled", True)
+            s.set("spark.rapids.sql.cluster.minWorkers", n)
+        return s
+
+    want = tpch.QUERIES["q3"](q3_session(), tpch_dir).collect()
+    root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("SRT_FAULTS", None)
+    res: dict = {"query": "q3", "shuffle_forced": True,
+                 "host_cpus": os.cpu_count()}
+    for n in (1, 2, 3):
+        sc = q3_session(n)
+        co = CL.get_coordinator(sc.conf)
+        addr = f"{co.addr[0]}:{co.addr[1]}"
+        procs = [subprocess.Popen(
+            [sys.executable, "-m",
+             "spark_rapids_tpu.parallel.cluster.worker",
+             "--coordinator", addr, "--worker-id", f"b{n}w{i}"],
+            env=env, cwd=root) for i in range(n)]
+        try:
+            df = tpch.QUERIES["q3"](sc, tpch_dir)
+            for _ in range(n + 1):
+                df.collect()
+            secs, got = None, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                got = df.collect()
+                dt = time.perf_counter() - t0
+                secs = dt if secs is None else min(secs, dt)
+            res[f"workers_{n}"] = {"seconds": round(secs, 4),
+                                   "correct": got == want}
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=15)
+                except Exception:
+                    p.kill()
+            CL.shutdown_coordinator()
+    w1 = res.get("workers_1", {}).get("seconds")
+    w3 = res.get("workers_3", {}).get("seconds")
+    if w1 and w3:
+        res["speedup_3v1"] = round(w1 / w3, 3)
+    return res
+
+
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_S", "420"))
     threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
@@ -508,6 +583,11 @@ def main():
         # shuffle/recovery) plus the Chrome trace JSON artifact path
         # (loads in Perfetto / chrome://tracing).
         "trace": {},
+        # Distributed worker runtime (parallel/cluster/): shuffle-forced
+        # q3 wall-clock at 1 vs 2 vs 3 subprocess workers through the
+        # stage-task coordinator, plus the 3-vs-1 speedup and per-config
+        # correctness against the same-conf local run.
+        "distributed": {},
     }
     with _LOCK:
         _STATE["out"] = out
@@ -629,6 +709,19 @@ def main():
                                       "BENCH_CONCURRENCY", "2")))
         with _LOCK:
             out["concurrency"] = conc
+
+    # Distributed worker runtime: 1 vs 2 vs 3 worker processes executing
+    # q3's stage DAG through the coordinator. The heaviest probe (each
+    # configuration boots fresh workers that pay their own JIT warm-up),
+    # so it needs the most headroom; BENCH_DISTRIBUTED=0 skips it.
+    if "q3" in _STATE["ok"] and _remaining(budget) > 150 and \
+            os.environ.get("BENCH_DISTRIBUTED", "1") != "0":
+        try:
+            dist = _distributed_probe(packs["q3"][1])
+        except Exception as e:  # the headline must survive a probe bug
+            dist = {"error": f"{type(e).__name__}: {e}"}
+        with _LOCK:
+            out["distributed"] = dist
 
     # Sustained serving load through the plan cache: the "millions of
     # users" block — mixed parameterized shapes, new literals per call.

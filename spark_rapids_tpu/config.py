@@ -714,6 +714,106 @@ SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS = conf(
     "manifests before failing with a lost-shard error (which flows "
     "into the recovery ladder).").integer(30000)
 
+SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.exclusiveManifest").doc(
+    "Single-writer manifest mode: the committing session publishes ONE "
+    "tag-scoped 'exchange.manifest.json' (atomic rename) instead of a "
+    "per-worker manifest, so a stage recompute on a DIFFERENT worker "
+    "atomically REPLACES the dead worker's manifest — a late fetcher "
+    "sees the old complete shard set or the new complete shard set, "
+    "never a mix. The cluster runtime (parallel/cluster/) opens every "
+    "stage-output session in this mode; expectedWorkers is forced to 1 "
+    "(one committed manifest IS the stage output).").boolean(False)
+
+SHUFFLE_TRANSPORT_HOSTFILE_RV_CONNECT_TIMEOUT_MS = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.rendezvous."
+    "connectTimeoutMs").doc(
+    "Socket connect/read timeout for one rendezvous round trip "
+    "(parallel/transport/rendezvous.py). A dead rendezvous peer fails "
+    "the round trip within this bound instead of hanging the fetch "
+    "indefinitely.").integer(5000)
+
+SHUFFLE_TRANSPORT_HOSTFILE_RV_RETRIES = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.rendezvous."
+    "retries").doc(
+    "Bounded retry count for one rendezvous round trip, with "
+    "deterministic exponential backoff between attempts "
+    "(rendezvous.backoffMs * 2^attempt, capped at 2s). Exhausted "
+    "retries raise RendezvousUnavailableError — typed 'UNAVAILABLE:' "
+    "so it maps onto the transient rung of the recovery ladder; the "
+    "hostfile transport additionally DEGRADES to manifest-file polling "
+    "instead of failing the fetch.").integer(3)
+
+SHUFFLE_TRANSPORT_HOSTFILE_RV_BACKOFF_MS = conf(
+    "spark.rapids.sql.shuffle.transport.hostfile.rendezvous."
+    "backoffMs").doc(
+    "Base backoff between rendezvous round-trip retries; attempt i "
+    "sleeps backoffMs * 2^i (deterministic, capped at 2s).").integer(50)
+
+CLUSTER_ENABLED = conf("spark.rapids.sql.cluster.enabled").doc(
+    "Distributed worker runtime (parallel/cluster/): the driver "
+    "partitions each query's stage DAG into stage tasks and dispatches "
+    "them to registered worker processes, which publish stage outputs "
+    "as owner-tagged shards through the hostfile shuffle transport. "
+    "false (the default) leaves every existing single-process code "
+    "path byte-for-byte unchanged. Queries ineligible for dispatch "
+    "(host-fallback nodes, mesh transport, no dispatchable stage, "
+    "caller-provided context) stand down to local execution even when "
+    "enabled.").boolean(False)
+
+CLUSTER_COORDINATOR = conf("spark.rapids.sql.cluster.coordinator").doc(
+    "host:port the driver-side coordinator binds its control-plane "
+    "socket on (the rendezvous protocol extended with stage-task "
+    "verbs). Workers register against this address. Empty = "
+    "127.0.0.1 with an OS-assigned port (tests; read the bound "
+    "address off the coordinator object).").string("")
+
+CLUSTER_DIR = conf("spark.rapids.sql.cluster.dir").doc(
+    "Shared spool directory for cluster stage outputs (the hostfile "
+    "transport's DCN stand-in). All workers and the driver must see "
+    "the same path. Empty = a per-process directory under the system "
+    "temp dir — single-machine clusters only.").string("")
+
+CLUSTER_MIN_WORKERS = conf("spark.rapids.sql.cluster.minWorkers").doc(
+    "Dispatch gate: stage tasks are held until this many workers have "
+    "registered (elastic membership — a worker joining later picks up "
+    "queued tasks immediately).").integer(1)
+
+CLUSTER_HEARTBEAT_TIMEOUT_MS = conf(
+    "spark.rapids.sql.cluster.heartbeatTimeoutMs").doc(
+    "A worker whose last heartbeat (or any control-plane traffic) is "
+    "older than this is declared dead: its RUNNING stage task is "
+    "requeued onto a survivor (one stage recompute — the partial spool "
+    "is cleared first), and its membership is dropped.").integer(10000)
+
+CLUSTER_POLL_MS = conf("spark.rapids.sql.cluster.pollMs").doc(
+    "Worker task-poll interval and the driver's dispatch-loop tick. "
+    "Workers heartbeat at a third of heartbeatTimeoutMs independently "
+    "of this.").integer(25)
+
+CLUSTER_DISPATCH_TIMEOUT_MS = conf(
+    "spark.rapids.sql.cluster.dispatchTimeoutMs").doc(
+    "How long the driver waits for the full stage-task set of one "
+    "query (including requeues after worker death) before failing the "
+    "dispatch with a typed UNAVAILABLE error that flows into the "
+    "recovery ladder.").integer(300000)
+
+CLUSTER_MAX_TASK_RETRIES = conf(
+    "spark.rapids.sql.cluster.maxTaskRetries").doc(
+    "Per-stage-task requeue budget (worker deaths + reported stage "
+    "failures). A task exhausting it fails the query dispatch instead "
+    "of requeueing forever.").integer(3)
+
+CLUSTER_STEAL_DELAY_MS = conf(
+    "spark.rapids.sql.cluster.stealDelayMs").doc(
+    "Delay scheduling: how long a ready stage task is reserved for its "
+    "preferred worker (most input-shard bytes, then rendezvous-hash "
+    "owner) before any polling worker may steal it. Keeps repeat-query "
+    "placement deterministic — a momentarily busy worker keeps its "
+    "stages instead of paying a fresh kernel trace on whichever "
+    "process grabbed them first. 0 disables the reservation.").integer(
+    200)
+
 NATIVE_ENABLED = conf("spark.rapids.sql.native.enabled").doc(
     "Native Pallas kernel layer (ops/native.py): re-implement the "
     "profiled top device-time sinks — the LSD radix sort's per-digit "

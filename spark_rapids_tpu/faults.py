@@ -22,9 +22,13 @@ env)::
   (flips one byte of a serialized frame at a corruption site; detected
   by the CRC32 frame checksum and re-read), ``lostoutput`` (simulates a
   lost durable stage output at an exchange site; recovered by the
-  lineage-scoped stage recompute, parallel/stages.py), or ``stall``
+  lineage-scoped stage recompute, parallel/stages.py), ``stall``
   (hangs the dispatch until the execution watchdog kills and
-  re-dispatches the partition, ops/base.py).
+  re-dispatches the partition, ops/base.py), or ``workerdeath``
+  (SIGKILLs the cluster worker process at the ``cluster.stage`` site,
+  parallel/cluster/worker.py — the coordinator's heartbeat monitor
+  detects the death and requeues the stage task on a survivor: one
+  stage recompute, never a dead query).
 - ``site``: a named injection point woven into the dispatch funnels:
   ``upload`` (wire codec device_put), ``download`` (result device_get),
   ``concat`` (batch coalescing), ``kernel`` (cached-kernel dispatch),
@@ -38,7 +42,8 @@ env)::
   owning stage; ``corrupt`` flips a byte of the fetched frame, detected
   by the CRC and refetched once, counter ``remoteShardRefetches``),
   ``spill.write`` / ``spill.read`` (disk tier I/O), ``wire``
-  (serialized spill frames — corrupt only).
+  (serialized spill frames — corrupt only), ``cluster.stage``
+  (cluster worker stage-task execution — workerdeath only).
 - ``arg``: an integer N fires on the first N hits of the site (default
   1); a float p in (0, 1) fires per-hit with probability p from a
   deterministic per-site PRNG seeded by
@@ -233,7 +238,7 @@ class FaultSpec:
 
 
 _KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall",
-          "lostshard")
+          "lostshard", "workerdeath")
 
 
 class FaultParseError(ValueError):

@@ -15,5 +15,6 @@ engine-shaped is lazy.
 from spark_rapids_tpu.monitoring.recorder import (     # noqa: F401
     LEVEL_KERNEL, LEVEL_OPERATOR, LEVEL_QUERY, category_breakdown,
     configure, enabled, events, export_chrome, instant, level,
-    maybe_configure, now_ns, open_span_count, query_ids, record_span,
-    reset, snapshot, span, thread_names, trace_enabled)
+    maybe_configure, now_ns, open_span_count, process_tag, query_ids,
+    record_span, reset, set_process_tag, snapshot, span, thread_names,
+    trace_enabled)

@@ -20,8 +20,13 @@ from __future__ import annotations
 from typing import Dict, List
 
 
-def to_chrome(events: List[tuple], thread_names: Dict[int, str]) -> dict:
-    """Render recorder event tuples into one Chrome trace document."""
+def to_chrome(events: List[tuple], thread_names: Dict[int, str],
+              process_tag: str = "") -> dict:
+    """Render recorder event tuples into one Chrome trace document.
+    ``process_tag`` prefixes every process track name — cluster worker
+    processes pass ``worker <wid>`` so their exports stay attributable
+    when several per-process traces are viewed side by side."""
+    prefix = f"{process_tag} " if process_tag else ""
     trace: List[dict] = []
     seen_pids = set()
     seen_tids = set()
@@ -30,7 +35,7 @@ def to_chrome(events: List[tuple], thread_names: Dict[int, str]) -> dict:
         if qid not in seen_pids:
             seen_pids.add(qid)
             trace.append({"ph": "M", "name": "process_name", "pid": qid,
-                          "args": {"name": f"query {qid}"}})
+                          "args": {"name": f"{prefix}query {qid}"}})
             trace.append({"ph": "M", "name": "process_sort_index",
                           "pid": qid, "args": {"sort_index": qid}})
         if (qid, tid) not in seen_tids:

@@ -89,6 +89,12 @@ _EPOCH_NS = time.perf_counter_ns()
 
 _faults = None                      # lazily-bound spark_rapids_tpu.faults
 
+# Process identity for exported traces. Empty in the driver; cluster
+# worker processes set "worker <wid>" so a worker-side trace export
+# names its tracks "worker w0 query N" and a merged multi-process view
+# stays attributable.
+_PROCESS_TAG = ""
+
 
 def _now_ns() -> int:
     return time.perf_counter_ns() - _EPOCH_NS
@@ -210,6 +216,17 @@ def instant(name: str, cat: str, args: Optional[dict] = None,
     q = qid if qid is not None else _current_query_id()
     _record(("i", name, cat, _now_ns(), None,
              threading.get_ident(), q, args), q)
+
+
+def set_process_tag(tag: str) -> None:
+    """Name this process in exported traces (cluster workers pass
+    ``worker <wid>``). Affects rendering only, never recording."""
+    global _PROCESS_TAG
+    _PROCESS_TAG = str(tag)
+
+
+def process_tag() -> str:
+    return _PROCESS_TAG
 
 
 def enabled() -> bool:
@@ -358,7 +375,7 @@ def export_chrome(path: Optional[str] = None,
     one process track per query, one thread track per worker thread.
     Writes ``path`` when given; returns the document either way."""
     from spark_rapids_tpu.monitoring.chrome import to_chrome
-    doc = to_chrome(events(query_id), thread_names())
+    doc = to_chrome(events(query_id), thread_names(), _PROCESS_TAG)
     if path:
         with open(path, "w") as f:
             json.dump(doc, f)

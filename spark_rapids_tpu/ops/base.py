@@ -60,7 +60,7 @@ class Metrics:
 # query_metrics_entry(), which registers the owner here — replacing the
 # ad-hoc per-call-site exemptions DataFrame.metrics() used to hardcode.
 _AUDIT_METRIC_GROUPS = {"Recovery", "Pipeline", "Scheduler", "Transport",
-                        "Cost"}
+                        "Cost", "Cluster"}
 _AUDIT_LOCK = threading.Lock()
 
 

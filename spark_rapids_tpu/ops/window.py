@@ -216,20 +216,62 @@ def compute_window(batch: DeviceBatch, exprs: Sequence[WindowExprSpec]):
         idx = jnp.arange(cap, dtype=jnp.int32)
         gid = _seg_id(new_part)
         gid = jnp.where(s_live, gid, jnp.int32(max(cap - 1, 0)))
+        t = wx.fn.result_type()
+        if t.is_string:
+            out_cols.append(_eval_one_string(batch, wx, perm, inv, s_live,
+                                             new_part, gid, idx, cap))
+            continue
         data, valid = _eval_one(batch, wx, perm, s_live, new_part,
                                 new_peer, seg_start, gid, idx, cap)
         # Scatter back to original order: sorted position p holds original
         # row perm[p]; result for original row r is at sorted pos inv[r].
-        t = wx.fn.result_type()
         data_orig = jnp.take(data, inv, axis=0)
         valid_orig = jnp.take(valid, inv, axis=0) & batch.row_mask()
-        if t.is_string:
-            lens_orig = jnp.take(valid, inv, axis=0)  # placeholder
-            raise NotImplementedError("string window results")
         data_orig = jnp.where(valid_orig, data_orig.astype(t.np_dtype),
                               jnp.zeros((), t.np_dtype))
         out_cols.append(DeviceColumn(t, data_orig, valid_orig))
     return DeviceBatch(tuple(out_cols), batch.num_rows)
+
+
+def _eval_one_string(batch, wx, perm, inv, s_live, new_part, gid, idx, cap):
+    """String-typed window results. The variable-width payload never flows
+    through the numeric window arithmetic: each branch computes, per output
+    row, the ORIGINAL row index whose string is the answer, and a single
+    ``DeviceColumn.gather`` moves the (bytes, lengths) rows."""
+    fn = wx.fn
+    col = as_device_column(fn.child.eval(batch), batch)
+    if isinstance(fn, (Lead, Lag)):
+        off = fn.offset if isinstance(fn, Lead) else -fn.offset
+        src = idx + off
+        ok = (src >= 0) & (src < cap)
+        src_c = jnp.clip(src, 0, cap - 1)
+        same = jnp.take(gid, src_c, axis=0) == gid
+        struct = ok & same & s_live & jnp.take(s_live, src_c, axis=0)
+        src_orig = jnp.take(jnp.take(perm, src_c, axis=0), inv, axis=0)
+        struct_orig = jnp.take(struct, inv, axis=0)
+    elif isinstance(fn, WindowAgg) and fn.kind in ("min", "max"):
+        frame = fn.frame
+        if not (frame.preceding is UNBOUNDED and
+                frame.following is UNBOUNDED and
+                not frame.running_with_peers):
+            raise NotImplementedError(
+                "string min/max window: whole-partition frames only")
+        # Second radix sort by (partition keys, child bytes) makes each
+        # partition's winner the first live row of its segment; nulls sort
+        # last, so an all-null partition's head is itself null.
+        spec2 = WindowSpec(wx.spec.partition_by,
+                           [SortOrder(fn.child, ascending=fn.kind == "min",
+                                      nulls_first=False)])
+        perm2, s_live2, new_part2, _ = _sorted_frame(batch, spec2)
+        inv2 = jnp.zeros((cap,), jnp.int32).at[perm2].set(
+            jnp.arange(cap, dtype=jnp.int32))
+        head = _segment_starts(new_part2, cap)
+        src_orig = jnp.take(jnp.take(perm2, head, axis=0), inv2, axis=0)
+        struct_orig = jnp.take(s_live2, inv2, axis=0)
+    else:
+        raise NotImplementedError(
+            "string window results for %s" % type(fn).__name__)
+    return col.gather(src_orig, struct_orig & batch.row_mask())
 
 
 def _eval_one(batch, wx, perm, s_live, new_part, new_peer, seg_start, gid,

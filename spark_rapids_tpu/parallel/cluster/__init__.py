@@ -1,0 +1,27 @@
+"""Distributed worker runtime (ISSUE 13 tentpole).
+
+N independent worker processes execute ONE query's stage DAG: the
+driver-side coordinator (:mod:`coordinator`) partitions the DAG
+(parallel/stages.py) into dispatchable stage tasks and assigns them —
+locality-aware — to worker processes (:mod:`worker`) that registered
+over the rendezvous control plane; each worker executes its assigned
+stage and publishes the stage output as owner-tagged shards through the
+hostfile shuffle transport (exclusive-manifest mode), where the driver
+and dependent stages fetch them. The reference gets this architecture
+for free from Spark's driver/executor split with the
+RapidsShuffleInternalManager shipping shards over UCX (PAPER.md L1/L3);
+this package is that split built directly on the engine's stage DAG and
+transport SPI — the SF10K / multi-slice DCN stand-in.
+
+``spark.rapids.sql.cluster.enabled=false`` (the default) leaves every
+existing code path byte-for-byte unchanged: the only hooks outside this
+package are a ``ctx.cache["cluster"]`` lookup in the exchange's session
+opener/materializer and the prepare/recompute/reset calls in the
+planner's recovery ladder, all of which no-op when the marker is
+absent.
+"""
+
+from spark_rapids_tpu.parallel.cluster.coordinator import (   # noqa: F401
+    ClusterCoordinator, ClusterDispatchError, ClusterExecInfo, QueryRun,
+    cluster_enabled, get_coordinator, maybe_prepare,
+    shutdown_coordinator, stage_plan)

@@ -1,0 +1,754 @@
+"""Driver-side cluster coordinator: stage-task scheduling + membership.
+
+One :class:`ClusterCoordinator` per driver process owns the control
+plane — a :class:`ClusterServer` extending the rendezvous wire protocol
+(parallel/transport/rendezvous.py) with stage-task verbs — and the
+shared spool directory. Each eligible query submits a :class:`QueryRun`
+whose physical plan is pickled to the spool once; workers unpickle it,
+rebuild the (deterministically numbered) stage DAG, and execute their
+assigned stages, publishing each stage output as an exclusive-manifest
+hostfile exchange under ``<spool>/q<qid>/s<sid>/``.
+
+Control-plane verbs (one UTF-8 line per connection, like the base
+rendezvous grammar):
+
+    CREG <wid>                                   -> OK
+    CBEAT <wid>                                  -> OK
+    CPOLL <wid> <known-qids|->                   -> CTASK <qid> <sid> <gen>
+                                                      <depgens|-> <b64 path>
+                                                  | CIDLE <stale-qids|->
+    CDONE <wid> <qid> <sid> <gen> <bytes>        -> OK
+    CFAIL <wid> <qid> <sid> <gen> <lost|-> <b64> -> OK
+    CSTATS                                       -> OK <b64 json>
+
+Scheduling is pull-based: an idle worker polls and the coordinator
+picks, among the READY tasks (all deps committed, dispatch gate of
+``cluster.minWorkers`` open), the one this worker has the most input
+bytes for — the locality-aware placement of the ISSUE (prefer the
+worker already holding the largest input shards); ties break to the
+smallest stage id, so placement is deterministic. Elastic membership
+falls out of the same pull loop: a worker registering mid-run simply
+starts winning polls for queued tasks.
+
+Failure story:
+
+- a worker whose heartbeat goes silent past ``heartbeatTimeoutMs`` is
+  declared dead; its RUNNING task's partial spool is cleared, its
+  generation bumps (a zombie's late commit with a stale generation is
+  ignored), and the task requeues onto a survivor — ONE stage
+  recompute, counted exactly like a lineage recompute, never a dead
+  query;
+- a worker that loses a DEP shard mid-fetch reports the owning stage
+  (``CFAIL ... <lost-sid>``): the dep recomputes and the task requeues
+  behind it;
+- the driver's own post-fetch loss (``ShardLostError`` in the reduce)
+  flows through the planner's rung-1 recompute, which calls
+  :meth:`QueryRun.recompute` so the REMOTE stage rewrites its spool.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.parallel.transport.rendezvous import RendezvousServer
+
+_LOG = logging.getLogger("spark_rapids_tpu.cluster")
+
+_PENDING, _RUNNING, _DONE = "pending", "running", "done"
+
+
+class ClusterDispatchError(RuntimeError):
+    """A query's stage-task set could not be completed (dispatch
+    timeout, task retry budget exhausted, or a worker-reported
+    non-recoverable stage failure)."""
+
+
+def cluster_enabled(conf) -> bool:
+    return bool(conf.get(C.CLUSTER_ENABLED))
+
+
+def stage_plan(root, graph=None) -> Tuple[object, Set[int],
+                                          Dict[int, Set[int]]]:
+    """(stage graph, dispatchable stage ids, dispatchable-dep map).
+
+    Dispatchable = stages whose boundary is a shuffle exchange: their
+    durable output lives in the transport spool, so ANY process can
+    produce or consume it. Broadcast stages are NOT dispatchable — a
+    broadcast single materializes into the consuming process's catalog
+    (Spark broadcast semantics: every executor holds the value), so
+    each process computes broadcast stages locally; the dep map
+    therefore flows THROUGH them transitively to the shuffle stages
+    they consume."""
+    from spark_rapids_tpu.parallel import stages as S
+    from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+    g = graph if graph is not None else S.build_stage_graph(root)
+    dispatchable = {sid for sid, st in g.stages.items()
+                    if isinstance(st.boundary, ShuffleExchangeExec)}
+    deps: Dict[int, Set[int]] = {}
+
+    def ddeps(sid: int) -> Set[int]:
+        got = deps.get(sid)
+        if got is not None:
+            return got
+        out: Set[int] = set()
+        for p in g.stages[sid].parents:
+            if p in dispatchable:
+                out.add(p)
+            out |= ddeps(p)
+        deps[sid] = out
+        return out
+
+    for sid in g.stages:
+        ddeps(sid)
+    return g, dispatchable, deps
+
+
+def _hrw_owner(wids: List[str], sid: int) -> Optional[str]:
+    """Highest-random-weight (rendezvous hash) owner of stage ``sid``
+    among worker ids ``wids``: deterministic for a given worker set
+    (md5, not the salted builtin hash), and removing one worker only
+    reassigns that worker's stages."""
+    if not wids:
+        return None
+    return max(wids, key=lambda w: hashlib.md5(
+        f"{w}|{sid}".encode()).digest())
+
+
+class ClusterExecInfo:
+    """Per-process cluster execution marker, parked at
+    ``ctx.cache["cluster"]``: maps each dispatchable boundary exchange
+    (by its in-process identity) to its cross-process stage tag and
+    builds the exclusive-manifest hostfile sessions the exchange layer
+    opens instead of its default transport. ``local_sid`` is the stage
+    THIS process is currently producing (None on the driver): its
+    boundary gets a write session; every other tagged exchange gets a
+    fetch-only session that adopts the committed manifest."""
+
+    def __init__(self, spool_dir: str, worker_id: str,
+                 tags: Dict[int, Tuple[int, str]],
+                 local_sid: Optional[int] = None):
+        self.spool_dir = spool_dir
+        self.worker_id = worker_id
+        self.tags = tags                  # id(exchange) -> (sid, tag)
+        self.local_sid = local_sid
+
+    def set_local(self, sid: Optional[int]) -> None:
+        self.local_sid = sid
+
+    def sid_of(self, exchange) -> Optional[int]:
+        ent = self.tags.get(id(exchange))
+        return None if ent is None else ent[0]
+
+    def is_remote(self, exchange) -> bool:
+        ent = self.tags.get(id(exchange))
+        return ent is not None and ent[0] != self.local_sid
+
+    def session_for(self, ctx, exchange):
+        """The cluster transport session for a tagged exchange, or None
+        (untagged — the exchange opens its configured transport as
+        before). Always hostfile + exclusive manifest on the query's
+        spool; keep_on_close because the COORDINATOR owns query-end
+        spool removal, not any one context's teardown."""
+        ent = self.tags.get(id(exchange))
+        if ent is None:
+            return None
+        sid, tag = ent
+        from spark_rapids_tpu.parallel import transport as T
+        from spark_rapids_tpu.parallel.transport.hostfile import \
+            HostFileTransport
+        raw = dict(ctx.conf.raw)
+        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key] = self.spool_dir
+        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID.key] = self.worker_id
+        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST.key] = True
+        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS.key] = ""
+        sess = HostFileTransport().open(
+            C.TpuConf(raw), tag, exchange.partitioning.num_partitions,
+            owner=id(exchange), catalog=ctx.catalog,
+            metrics=T.metrics_entry(ctx))
+        sess.keep_on_close = True
+        sess.fetch_only = sid != self.local_sid
+        return sess
+
+    @staticmethod
+    def adopt_manifest(sess, num_partitions: int) -> List[int]:
+        """Reconstruct the map-side observations (exact per-bucket row
+        counts + shard bytes) from the committed manifest, so the
+        reduce side's AQE coalescing and the replanner's byte
+        observations are IDENTICAL to the process that produced the
+        stage — the bit-identity keystone."""
+        rows = [0] * num_partitions
+        for m in sess._load_manifests():
+            for p_s, entries in m.get("shards", {}).items():
+                p = int(p_s)
+                for e in entries:
+                    rows[p] += int(e.get("rows") or 0)
+                    sess.record_shard_bytes(p, int(e.get("bytes") or 0))
+        return rows
+
+
+class _StageTask:
+    __slots__ = ("sid", "deps", "status", "worker", "gen", "retries",
+                 "bytes", "producer", "ready_ts")
+
+    def __init__(self, sid: int, deps: Set[int]):
+        self.sid = sid
+        self.deps = deps
+        self.status = _PENDING
+        self.worker: Optional[str] = None
+        self.gen = 0
+        self.retries = 0
+        self.bytes = 0
+        self.producer: Optional[str] = None
+        self.ready_ts: Optional[float] = None   # first observed ready
+
+
+class _WorkerInfo:
+    __slots__ = ("wid", "last_seen", "alive", "completed")
+
+    def __init__(self, wid: str, now: float):
+        self.wid = wid
+        self.last_seen = now
+        self.alive = True
+        self.completed = 0
+
+
+class QueryRun:
+    """One query's dispatch state: the pickled plan, its stage tasks,
+    and the driver-side wait/recovery surface the planner drives."""
+
+    def __init__(self, co: "ClusterCoordinator", qid: int, conf,
+                 tasks: Dict[int, _StageTask], driver_tags):
+        self.co = co
+        self.qid = qid
+        self.qdir = os.path.join(co.base_dir, f"q{qid}")
+        self.pkl_path = os.path.join(self.qdir, "query.pkl")
+        self.tasks = tasks
+        self._driver_tags = driver_tags
+        self.min_workers = max(int(conf.get(C.CLUSTER_MIN_WORKERS)), 1)
+        self.poll_ms = max(int(conf.get(C.CLUSTER_POLL_MS)), 1)
+        self.hb_timeout_ms = max(
+            int(conf.get(C.CLUSTER_HEARTBEAT_TIMEOUT_MS)), 1)
+        self.dispatch_timeout_ms = max(
+            int(conf.get(C.CLUSTER_DISPATCH_TIMEOUT_MS)), 1)
+        self.max_retries = max(int(conf.get(C.CLUSTER_MAX_TASK_RETRIES)),
+                               0)
+        self.steal_delay_s = max(
+            int(conf.get(C.CLUSTER_STEAL_DELAY_MS)), 0) / 1000.0
+        self.error: Optional[BaseException] = None
+        self._ctx = None
+        self._trace_qid = 0
+        self.finished = False
+
+    # -- driver side (planner hooks) -----------------------------------------
+    def install(self, ctx) -> None:
+        """Mark ``ctx`` as this query's cluster execution context: the
+        exchanges of the plan resolve their cross-process tags and
+        fetch-only roles through the installed ClusterExecInfo."""
+        self._ctx = ctx
+        self._trace_qid = ctx.cache.get("trace_query", 0)
+        ctx.cache["cluster"] = ClusterExecInfo(
+            self.qdir, f"drv{os.getpid()}", self._driver_tags,
+            local_sid=None)
+
+    def _metrics(self):
+        from spark_rapids_tpu.ops.base import query_metrics_entry
+        return query_metrics_entry(self._ctx, "Cluster")
+
+    def run(self, ctx) -> None:
+        """Dispatch-and-wait barrier: returns once every stage task of
+        this query is committed to the spool (requeueing through worker
+        deaths and reported shard losses on the way), so the local
+        collect that follows only ever FETCHES remote stage outputs."""
+        from spark_rapids_tpu import faults, monitoring
+        t0 = time.monotonic()
+        deadline = t0 + self.dispatch_timeout_ms / 1000.0
+        while True:
+            faults.check_cancelled()
+            with self.co._lock:
+                self.co._check_workers_locked()
+                err = self.error
+                done = all(t.status == _DONE
+                           for t in self.tasks.values())
+            if err is not None:
+                raise err
+            if done:
+                break
+            if time.monotonic() > deadline:
+                raise ClusterDispatchError(
+                    f"UNAVAILABLE: cluster dispatch of query {self.qid} "
+                    f"incomplete after {self.dispatch_timeout_ms}ms "
+                    f"({self._progress()})")
+            time.sleep(self.poll_ms / 1000.0)
+        m = self._metrics()
+        m.add("dispatchWaitMs", (time.monotonic() - t0) * 1000.0)
+        with self.co._lock:
+            workers = {t.producer for t in self.tasks.values()
+                       if t.producer}
+        with m._lock:
+            m.values["workersUsed"] = max(
+                m.values.get("workersUsed", 0), len(workers))
+        monitoring.instant(
+            "cluster-dispatch-complete", "cluster",
+            args={"query": self.qid, "stages": len(self.tasks),
+                  "workers": len(workers)}, qid=self._trace_qid)
+
+    def _progress(self) -> str:
+        by = {}
+        for t in self.tasks.values():
+            by[t.status] = by.get(t.status, 0) + 1
+        return ", ".join(f"{k}={v}" for k, v in sorted(by.items()))
+
+    def recompute(self, sid: int) -> None:
+        """Planner rung-1 hook: the driver lost stage ``sid``'s durable
+        output post-dispatch (ShardLostError / persistent CRC failure
+        on the reduce fetch). Clear the stage's spool and requeue it;
+        the planner's continue re-enters :meth:`run`, which waits for
+        the rewritten manifest."""
+        with self.co._lock:
+            t = self.tasks.get(sid)
+            if t is None:
+                return
+            self._requeue_locked(t, "driver-observed shard loss")
+
+    def reset(self) -> None:
+        """Planner rung-3 hook (fresh-context retry): every stage task
+        redispatches from a clean spool."""
+        with self.co._lock:
+            for t in self.tasks.values():
+                t.gen += 1
+                t.status = _PENDING
+                t.worker = None
+                t.ready_ts = None
+            shutil.rmtree(self.qdir, ignore_errors=True)
+            os.makedirs(self.qdir, exist_ok=True)
+            self.co._write_plan(self)
+
+    def finish(self) -> None:
+        """Query end (success or failure): retire the run and remove
+        the query's spool tree — the coordinator owns this cleanup, so
+        worker/driver context teardowns never race each other over
+        live shard files (their sessions are keep_on_close)."""
+        with self.co._lock:
+            self.finished = True
+            self.co.queries.pop(self.qid, None)
+        shutil.rmtree(self.qdir, ignore_errors=True)
+
+    # -- coordinator side (lock held) ----------------------------------------
+    def _requeue_locked(self, t: _StageTask, why: str,
+                        count_recompute: bool = True) -> None:
+        from spark_rapids_tpu import faults, monitoring
+        t.gen += 1
+        t.status = _PENDING
+        t.worker = None
+        t.ready_ts = None
+        t.retries += 1
+        shutil.rmtree(os.path.join(self.qdir, f"s{t.sid}"),
+                      ignore_errors=True)
+        if t.retries > self.max_retries:
+            self.error = ClusterDispatchError(
+                f"stage task s{t.sid} of query {self.qid} exhausted its "
+                f"{self.max_retries} requeue(s): {why}")
+            return
+        if count_recompute:
+            faults.record("stageRecomputes")
+            faults.record(f"stageRecomputes.stage{t.sid}")
+            if self._ctx is not None:
+                self._metrics().add("tasksRequeued", 1)
+        monitoring.instant("cluster-task-requeue", "recovery",
+                           args={"query": self.qid, "stage": t.sid,
+                                 "why": why}, qid=self._trace_qid)
+        _LOG.warning("cluster: requeueing stage s%d of query %d "
+                     "(gen %d): %s", t.sid, self.qid, t.gen, why)
+
+    def _ready_locked(self) -> List[_StageTask]:
+        now = time.monotonic()
+        out = []
+        for t in self.tasks.values():
+            if t.status == _PENDING and all(
+                    self.tasks[d].status == _DONE
+                    for d in t.deps if d in self.tasks):
+                if t.ready_ts is None:
+                    t.ready_ts = now    # starts the steal-delay clock
+                out.append(t)
+        return out
+
+    def _pick_locked(self, wid: str) -> Optional[Tuple[str, _StageTask]]:
+        """The stage task worker ``wid`` should run next: the ready
+        task it holds the most input-shard bytes for (locality), ties
+        to the task whose rendezvous-hash owner this worker is
+        (stable placement), then to the smallest stage id
+        (determinism). None = nothing ready or the minWorkers dispatch
+        gate is closed.
+
+        The affinity tier matters for repeated queries: score ties
+        (every leaf stage — no input shards yet) would otherwise land
+        on whichever worker polls first, scattering the same stage
+        onto a different process each query and re-paying its
+        per-process kernel traces. Highest-random-weight hashing over
+        the live worker set keeps the split deterministic across
+        queries AND work-conserving — a worker whose owned tasks are
+        all taken still picks up anything ready.
+
+        Delay scheduling closes the remaining race: a ready task is
+        reserved for its *preferred* worker — the alive worker with
+        the best (score, owned) pair — for ``stealDelayMs``. Without
+        the reservation a momentarily busy worker loses its stages to
+        whichever idle process polls first, so the stage→worker map
+        flips between otherwise identical queries and the thief pays
+        a fresh per-process kernel trace. The delay keeps stealing
+        (and so work conservation) for genuinely stuck owners while
+        making hot-path placement deterministic."""
+        if self.error is not None or self.finished:
+            return None
+        alive = self.co._alive_wids_locked()
+        if len(alive) < self.min_workers:
+            return None
+        ready = self._ready_locked()
+        if not ready:
+            return None
+
+        def score(t: _StageTask, w: str) -> int:
+            return sum(self.tasks[d].bytes for d in t.deps
+                       if d in self.tasks
+                       and self.tasks[d].producer == w)
+
+        def owned(t: _StageTask, w: str) -> int:
+            return 1 if _hrw_owner(alive, t.sid) == w else 0
+
+        def rank(t: _StageTask, w: str) -> Tuple[int, int]:
+            return (score(t, w), owned(t, w))
+
+        now = time.monotonic()
+
+        def eligible(t: _StageTask) -> bool:
+            if self.steal_delay_s <= 0 or \
+                    now - (t.ready_ts or now) >= self.steal_delay_s:
+                return True     # reservation expired: anyone may take it
+            mine = rank(t, wid)
+            return all(rank(t, w) <= mine for w in alive if w != wid)
+
+        ready = [t for t in ready if eligible(t)]
+        if not ready:
+            return None         # reserved for others — poll again shortly
+        best = max(ready, key=lambda t: rank(t, wid) + (-t.sid,))
+        best.status = _RUNNING
+        best.worker = wid
+        depgens = ",".join(f"{d}:{self.tasks[d].gen}"
+                           for d in sorted(best.deps)) or "-"
+        line = (f"CTASK {self.qid} {best.sid} {best.gen} {depgens} "
+                f"{base64.b64encode(self.pkl_path.encode()).decode()}\n")
+        return line, best
+
+    def _on_done_locked(self, wid: str, sid: int, gen: int,
+                        nbytes: int) -> None:
+        t = self.tasks.get(sid)
+        if t is None or t.gen != gen or t.status != _RUNNING or \
+                t.worker != wid:
+            return          # stale generation (zombie worker) — ignored
+        t.status = _DONE
+        t.bytes = nbytes
+        t.producer = wid
+        w = self.co.workers.get(wid)
+        if w is not None:
+            w.completed += 1
+        if self._ctx is not None:
+            self._metrics().add("stagesCompleted", 1)
+
+    def _on_fail_locked(self, wid: str, sid: int, gen: int,
+                        lost_sid: Optional[int], msg: str) -> None:
+        t = self.tasks.get(sid)
+        if t is None or t.gen != gen or t.worker != wid or \
+                t.status != _RUNNING:
+            return
+        if lost_sid is not None and lost_sid in self.tasks:
+            lost = self.tasks[lost_sid]
+            if lost.status == _DONE:
+                self._requeue_locked(lost,
+                                     f"shard loss reported by {wid}")
+        # The failed task itself retries behind the recomputed dep; a
+        # loss-free failure (a real stage error) still retries — a
+        # persistent bug exhausts the budget and surfaces the message.
+        self._requeue_locked(t, f"{wid} reported: {msg}",
+                             count_recompute=lost_sid is not None)
+
+
+class ClusterServer(RendezvousServer):
+    """The rendezvous server + the cluster control-plane verbs: workers
+    literally 'register with the rendezvous' (ISSUE wording) — one
+    socket, one wire grammar, shard-commit announcements and stage-task
+    scheduling side by side."""
+
+    def __init__(self, co: "ClusterCoordinator", host: str, port: int):
+        self._co = co
+        super().__init__(host, port)
+
+    def dispatch_extra(self, parts: List[str]) -> Optional[bytes]:
+        return self._co.dispatch(parts)
+
+
+class ClusterCoordinator:
+    """Driver-side membership + scheduling authority (one per driver
+    process in practice; instantiable standalone for tests/bench)."""
+
+    def __init__(self, conf):
+        self._lock = threading.Lock()
+        self.workers: Dict[str, _WorkerInfo] = {}
+        self.queries: Dict[int, QueryRun] = {}
+        self._next_qid = 1
+        self.base_dir = str(conf.get(C.CLUSTER_DIR) or "") or \
+            os.path.join(tempfile.gettempdir(),
+                         f"srt_cluster_{os.getpid()}")
+        os.makedirs(self.base_dir, exist_ok=True)
+        self.hb_timeout_ms = max(
+            int(conf.get(C.CLUSTER_HEARTBEAT_TIMEOUT_MS)), 1)
+        spec = str(conf.get(C.CLUSTER_COORDINATOR) or "")
+        if spec:
+            host, _, port = spec.rpartition(":")
+            self.server = ClusterServer(self, host or "127.0.0.1",
+                                        int(port))
+        else:
+            self.server = ClusterServer(self, "127.0.0.1", 0)
+        self.addr = self.server.addr
+
+    # -- membership/scheduling (socket threads) ------------------------------
+    def _alive_count_locked(self) -> int:
+        return sum(1 for w in self.workers.values() if w.alive)
+
+    def _alive_wids_locked(self) -> List[str]:
+        return [w.wid for w in self.workers.values() if w.alive]
+
+    def _touch_locked(self, wid: str) -> _WorkerInfo:
+        now = time.monotonic()
+        w = self.workers.get(wid)
+        if w is None or not w.alive:
+            from spark_rapids_tpu import monitoring
+            fresh = w is None
+            w = self.workers[wid] = _WorkerInfo(wid, now)
+            monitoring.instant("worker-join", "cluster",
+                               args={"worker": wid, "rejoin": not fresh})
+            _LOG.info("cluster: worker %s %sjoined", wid,
+                      "" if fresh else "re")
+        w.last_seen = now
+        return w
+
+    def _check_workers_locked(self) -> None:
+        """Heartbeat monitor (driven from QueryRun.run's wait loop): a
+        silent worker is declared dead and every RUNNING task it held —
+        across all active queries — requeues onto survivors."""
+        now = time.monotonic()
+        for w in self.workers.values():
+            if not w.alive or \
+                    (now - w.last_seen) * 1000.0 < self.hb_timeout_ms:
+                continue
+            w.alive = False
+            from spark_rapids_tpu import faults, monitoring
+            faults.record("clusterWorkerDeaths")
+            monitoring.instant("worker-death", "recovery",
+                               args={"worker": w.wid})
+            _LOG.warning("cluster: worker %s heartbeat silent for "
+                         ">%dms — declared dead; requeueing its tasks",
+                         w.wid, self.hb_timeout_ms)
+            for q in self.queries.values():
+                for t in q.tasks.values():
+                    if t.status == _RUNNING and t.worker == w.wid:
+                        if q._ctx is not None:
+                            q._metrics().add("workerDeaths", 1)
+                        q._requeue_locked(
+                            t, f"worker {w.wid} died mid-stage")
+
+    def dispatch(self, parts: List[str]) -> Optional[bytes]:
+        try:
+            return self._dispatch(parts)
+        except Exception:                      # a torn request must not
+            _LOG.exception("cluster verb failed: %r", parts)
+            return b"ERR\n"                    # kill the handler thread
+
+    def _dispatch(self, parts: List[str]) -> Optional[bytes]:
+        cmd = parts[0].upper()
+        if cmd == "CREG" and len(parts) == 2:
+            with self._lock:
+                self._touch_locked(parts[1])
+            return b"OK\n"
+        if cmd == "CBEAT" and len(parts) == 2:
+            with self._lock:
+                self._touch_locked(parts[1])
+            return b"OK\n"
+        if cmd == "CPOLL" and len(parts) == 3:
+            wid, known = parts[1], parts[2]
+            with self._lock:
+                self._touch_locked(wid)
+                stale = [q for q in known.split(",")
+                         if q and q != "-"
+                         and int(q) not in self.queries]
+                for qid in sorted(self.queries):
+                    picked = self.queries[qid]._pick_locked(wid)
+                    if picked is not None:
+                        line, _ = picked
+                        return line.encode()
+            return f"CIDLE {','.join(stale) or '-'}\n".encode()
+        if cmd == "CDONE" and len(parts) == 6:
+            _, wid, qid, sid, gen, nbytes = parts
+            with self._lock:
+                self._touch_locked(wid)
+                q = self.queries.get(int(qid))
+                if q is not None:
+                    q._on_done_locked(wid, int(sid), int(gen),
+                                      int(nbytes))
+            return b"OK\n"
+        if cmd == "CFAIL" and len(parts) == 7:
+            _, wid, qid, sid, gen, lost, b64 = parts
+            msg = base64.b64decode(b64).decode("utf-8", "replace")
+            with self._lock:
+                self._touch_locked(wid)
+                q = self.queries.get(int(qid))
+                if q is not None:
+                    q._on_fail_locked(
+                        wid, int(sid), int(gen),
+                        None if lost == "-" else int(lost), msg)
+            return b"OK\n"
+        if cmd == "CSTATS" and len(parts) == 1:
+            blob = base64.b64encode(
+                json.dumps(self.stats()).encode()).decode()
+            return f"OK {blob}\n".encode()
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            return {
+                "workers": {
+                    w.wid: {"alive": w.alive, "completed": w.completed,
+                            "idle_ms": int((now - w.last_seen) * 1000)}
+                    for w in self.workers.values()},
+                "queries": {
+                    str(qid): {
+                        str(t.sid): {"status": t.status,
+                                     "worker": t.worker, "gen": t.gen,
+                                     "retries": t.retries,
+                                     "producer": t.producer,
+                                     "bytes": t.bytes}
+                        for t in q.tasks.values()}
+                    for qid, q in self.queries.items()},
+            }
+
+    # -- query submission (driver thread) ------------------------------------
+    def submit(self, phys, conf, graph=None,
+               binds=None) -> Optional[QueryRun]:
+        """Partition ``phys``'s stage DAG into dispatchable tasks and
+        open a QueryRun, or None when the plan has no dispatchable
+        stage or cannot cross a process boundary (unpicklable)."""
+        from spark_rapids_tpu.parallel import stages as S
+        if graph is None:
+            graph = S.build_stage_graph(phys.root)
+        _, dispatchable, deps = stage_plan(phys.root, graph)
+        if not dispatchable:
+            return None
+        worker_raw = {
+            k: v for k, v in phys.conf.raw.items()
+            # Conf-armed fault schedules stay driver-side: a spec
+            # shipped to every worker would fire the same injection N
+            # times (once per process). Worker-scoped chaos arms via
+            # each worker's SRT_FAULTS environment instead.
+            if not k.startswith("spark.rapids.sql.test.faults")
+            and k != C.CLUSTER_ENABLED.key}
+        try:
+            blob = pickle.dumps((phys.root, worker_raw, binds))
+        except Exception as e:
+            _LOG.warning("cluster: plan not picklable (%s: %s) — "
+                         "standing down to local execution",
+                         type(e).__name__, e)
+            return None
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+            tasks = {sid: _StageTask(sid, deps.get(sid, set())
+                                     & dispatchable)
+                     for sid in dispatchable}
+            driver_tags = {id(graph.stages[sid].boundary):
+                           (sid, f"s{sid}")
+                           for sid in dispatchable}
+            q = QueryRun(self, qid, conf, tasks, driver_tags)
+            q._blob = blob
+            os.makedirs(q.qdir, exist_ok=True)
+            self._write_plan(q)
+            self.queries[qid] = q
+        from spark_rapids_tpu import monitoring
+        monitoring.instant("cluster-submit", "cluster",
+                           args={"query": qid,
+                                 "stages": len(dispatchable)})
+        return q
+
+    def _write_plan(self, q: QueryRun) -> None:
+        tmp = q.pkl_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(q._blob)
+        os.replace(tmp, q.pkl_path)
+
+    def close(self) -> None:
+        self.server.close()
+        shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+# -- process-global coordinator (driver side) --------------------------------
+
+_CO: Optional[ClusterCoordinator] = None
+_CO_LOCK = threading.Lock()
+
+
+def get_coordinator(conf) -> ClusterCoordinator:
+    """The driver process's coordinator, created on first use from
+    ``conf``'s cluster.* keys (later calls return the same instance —
+    one control plane per driver, like the query manager)."""
+    global _CO
+    with _CO_LOCK:
+        if _CO is None:
+            _CO = ClusterCoordinator(conf)
+        return _CO
+
+
+def shutdown_coordinator() -> None:
+    """Tear down the process-global coordinator (tests/bench)."""
+    global _CO
+    with _CO_LOCK:
+        co, _CO = _CO, None
+    if co is not None:
+        co.close()
+
+
+def maybe_prepare(phys, ctx, graph=None) -> Optional[QueryRun]:
+    """The planner's prepare hook: a QueryRun for this collect, or None
+    when the query must run locally. Stand-downs keep cluster mode
+    CORRECT rather than clever — any query the dispatch model cannot
+    represent simply executes exactly as before."""
+    conf = ctx.conf
+    if not cluster_enabled(conf):
+        return None
+    if not phys.root_on_device or phys.host_fallback_nodes():
+        return None             # host islands run the oracle engine
+    from spark_rapids_tpu.parallel import transport as T
+    if T.transport_name(conf) == "mesh":
+        return None             # collective exchange owns the shuffle
+    co = get_coordinator(conf)
+    binds = None
+    if "plan_binds" in ctx.cache:
+        # A plan-cache template executes against per-collect bound
+        # literals; workers need them to resolve bind slots.
+        binds = (ctx.cache["plan_binds"], ctx.cache["plan_bind_dtypes"])
+    q = co.submit(phys, conf, graph, binds)
+    if q is None:
+        return None
+    q.install(ctx)
+    m = q._metrics()
+    m.add("stagesDispatched", len(q.tasks))
+    return q

@@ -1,0 +1,352 @@
+"""Cluster worker process: executes assigned stages of a query's DAG.
+
+Run as a standalone process (scripts/cluster.py launches N of them):
+
+    python -m spark_rapids_tpu.parallel.cluster.worker \
+        --coordinator 127.0.0.1:40123 --worker-id w0
+
+Lifecycle: register with the coordinator's rendezvous (``CREG``, with
+the hardened bounded-retry connect), heartbeat from a daemon thread
+(``CBEAT``), and pull stage tasks in the main loop (``CPOLL``). For
+each task the worker unpickles the query's physical plan ONCE per
+query (the deterministic DFS stage numbering of
+parallel/stages.build_stage_graph makes its local stage ids agree with
+the driver's), installs a :class:`ClusterExecInfo` marking the
+assigned stage as LOCAL (write session) and every other dispatchable
+stage as REMOTE (fetch-only adoption of the committed spool), and
+drives the boundary exchange's ``stage_prematerialize`` — exactly the
+code path the single-process pipelined executor runs, pointed at the
+shared spool. Success reports ``CDONE`` with the observed output
+bytes (the coordinator's locality scores); failure reports ``CFAIL``,
+owner-tagged with the lost dep stage when the error carries a
+``fault_owner``, so the coordinator recomputes the dep instead of
+blindly retrying the consumer.
+
+Chaos: arming ``SRT_FAULTS=workerdeath@cluster.stage:1`` in ONE
+worker's environment SIGKILLs that worker at the injection site just
+before it executes a stage — the coordinator's heartbeat monitor
+detects the death and requeues the task on a survivor (exactly one
+stage recompute, never a dead query).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+if __name__ == "__main__":          # bare-script env hygiene, before jax
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))))
+
+import argparse
+import base64
+import logging
+import pickle
+import signal
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+_LOG = logging.getLogger("spark_rapids_tpu.cluster.worker")
+
+
+def _drop_remote_plugins() -> None:
+    """CPU-pinned worker hygiene (mirrors tests/conftest.py): the
+    environment may register a remote-TPU PJRT plugin whose tunnel
+    claim costs seconds — a CPU worker must not initialize it."""
+    if os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        return
+    try:
+        import jax
+        import jax._src.xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:                          # pragma: no cover - env
+        pass
+
+
+class _QueryState:
+    """One query's cached plan + execution context on this worker:
+    unpickled once, reused across every task of the query."""
+
+    __slots__ = ("root", "conf", "graph", "info", "ctx", "gens")
+
+    def __init__(self, root, conf, graph, info, ctx):
+        self.root = root
+        self.conf = conf
+        self.graph = graph
+        self.info = info
+        self.ctx = ctx
+        self.gens: Dict[int, int] = {}     # sid -> last generation seen
+
+
+class Worker:
+    def __init__(self, coordinator: Tuple[str, int], worker_id: str,
+                 poll_ms: int = 25, heartbeat_ms: int = 2000,
+                 max_idle_s: float = 0.0):
+        self.addr = coordinator
+        self.wid = worker_id
+        self.poll_ms = max(int(poll_ms), 1)
+        self.heartbeat_ms = max(int(heartbeat_ms), 1)
+        self.max_idle_s = float(max_idle_s)
+        self.queries: Dict[int, _QueryState] = {}
+        self._stop = threading.Event()
+        self.tasks_done = 0
+
+    # -- control plane --------------------------------------------------------
+    def _call(self, line: str, timeout_s: float = 10.0) -> str:
+        from spark_rapids_tpu.parallel.transport import rendezvous as RV
+        if not line.endswith("\n"):
+            line += "\n"
+        return RV._roundtrip(self.addr, line, timeout_s=timeout_s,
+                             retries=3, backoff_ms=50)
+
+    def register(self, deadline_s: float = 30.0) -> None:
+        """CREG with retry-until-deadline: the launcher may start
+        workers before the coordinator binds (elastic join is the same
+        code path — a worker registering mid-run just starts winning
+        polls)."""
+        from spark_rapids_tpu.parallel.transport.rendezvous import \
+            RendezvousUnavailableError
+        end = time.monotonic() + deadline_s
+        while True:
+            try:
+                self._call(f"CREG {self.wid}")
+                return
+            except RendezvousUnavailableError:
+                if time.monotonic() >= end:
+                    raise
+                time.sleep(0.1)
+
+    def _heartbeat_loop(self) -> None:
+        from spark_rapids_tpu.parallel.transport.rendezvous import \
+            RendezvousUnavailableError
+        interval = self.heartbeat_ms / 3000.0
+        while not self._stop.wait(interval):
+            try:
+                self._call(f"CBEAT {self.wid}", timeout_s=5.0)
+            except RendezvousUnavailableError:
+                # The main loop owns the exit decision; a missed beat
+                # on a live coordinator merely looks slow.
+                pass
+
+    # -- task execution -------------------------------------------------------
+    def _load_query(self, qid: int, pkl_path: str) -> _QueryState:
+        st = self.queries.get(qid)
+        if st is not None:
+            return st
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu import faults, monitoring
+        from spark_rapids_tpu.ops.base import ExecContext
+        from spark_rapids_tpu.parallel.cluster.coordinator import (
+            ClusterExecInfo, stage_plan)
+        with open(pkl_path, "rb") as f:
+            root, raw, binds = pickle.loads(f.read())
+        conf = C.TpuConf(raw)
+        monitoring.maybe_configure(conf)
+        faults.maybe_configure(conf)
+        graph, dispatchable, _ = stage_plan(root)
+        tags = {id(graph.stages[sid].boundary): (sid, f"s{sid}")
+                for sid in dispatchable}
+        info = ClusterExecInfo(os.path.dirname(pkl_path), self.wid,
+                               tags, local_sid=None)
+        ctx = ExecContext(conf)
+        ctx.cache["engine"] = "device"
+        ctx.cache["cluster"] = info
+        if binds is not None:
+            # Parameterized plan-cache template: the driver's bound
+            # literals ride along in the plan blob so bind slots
+            # resolve to THIS collect's values in every process.
+            ctx.cache["plan_binds"] = tuple(binds[0])
+            ctx.cache["plan_bind_dtypes"] = tuple(binds[1])
+        st = _QueryState(root, conf, graph, info, ctx)
+        self.queries[qid] = st
+        _LOG.info("worker %s: loaded query %d (%d dispatchable stages)",
+                  self.wid, qid, len(dispatchable))
+        return st
+
+    def _close_query(self, qid: int) -> None:
+        st = self.queries.pop(qid, None)
+        if st is not None:
+            try:
+                st.ctx.close()
+            except Exception:                  # pragma: no cover - teardown
+                _LOG.exception("worker %s: context close of query %d",
+                               self.wid, qid)
+
+    def _sync_gens(self, st: _QueryState, sid: int, gen: int,
+                   depgens: str) -> None:
+        """Invalidate locally-cached stage state whose generation moved
+        on: a requeued/recomputed stage's old spool is gone, so this
+        worker's cached sessions and bucket caches for it are stale."""
+        want = {sid: gen}
+        if depgens and depgens != "-":
+            for ent in depgens.split(","):
+                d, _, g = ent.partition(":")
+                want[int(d)] = int(g)
+        for s, g in want.items():
+            seen = st.gens.get(s)
+            if seen is not None and seen != g:
+                boundary = st.graph.stages[s].boundary
+                if boundary is not None:
+                    boundary.stage_invalidate(st.ctx)
+                _LOG.info("worker %s: stage s%d moved gen %d -> %d; "
+                          "dropped cached state", self.wid, s, seen, g)
+            st.gens[s] = g
+
+    def execute(self, qid: int, sid: int, gen: int, depgens: str,
+                pkl_path: str) -> None:
+        from spark_rapids_tpu import faults, monitoring
+        st = self._load_query(qid, pkl_path)
+        self._sync_gens(st, sid, gen, depgens)
+        st.info.set_local(sid)
+        try:
+            # The workerdeath chaos site: a SIGKILL here leaves the
+            # task RUNNING at the coordinator until the heartbeat
+            # timeout declares this worker dead — real process death,
+            # not a simulated exception.
+            if faults.check_fault("cluster.stage",
+                                  ("workerdeath",)) is not None:
+                _LOG.warning("worker %s: injected workerdeath — "
+                             "SIGKILL", self.wid)
+                logging.shutdown()
+                os.kill(os.getpid(), signal.SIGKILL)
+            boundary = st.graph.stages[sid].boundary
+            with monitoring.span("cluster-stage", "cluster",
+                                 args={"query": qid, "stage": sid,
+                                       "worker": self.wid}):
+                boundary.stage_prematerialize(st.ctx)
+            sess = st.ctx.cache.get(boundary._cache_key(True))
+            nbytes = sess.observed_bytes() if sess is not None else 0
+        except Exception as e:
+            lost = self._lost_dep(st, sid, e)
+            msg = base64.b64encode(
+                f"{type(e).__name__}: {e}"[:512].encode()).decode()
+            _LOG.warning("worker %s: stage s%d of query %d failed "
+                         "(lost dep: %s): %s", self.wid, sid, qid,
+                         lost, e, exc_info=True)
+            self._call(f"CFAIL {self.wid} {qid} {sid} {gen} "
+                       f"{'-' if lost is None else lost} {msg}")
+            return
+        finally:
+            st.info.set_local(None)
+        self.tasks_done += 1
+        self._call(f"CDONE {self.wid} {qid} {sid} {gen} {nbytes}")
+
+    def _lost_dep(self, st: _QueryState, sid: int,
+                  e: BaseException) -> Optional[int]:
+        """Map an owner-tagged failure (ShardLostError, persistent CRC
+        loss) to the DEP stage whose spool is gone — the coordinator
+        recomputes it before requeueing this task. The failing stage's
+        OWN id is not a lost dep (its output was never committed)."""
+        owner = getattr(e, "fault_owner", None)
+        if owner is None:
+            return None
+        lost = st.graph.by_exchange.get(owner)
+        if lost is None or lost == sid:
+            return None
+        # A lost dep's local fetch state is stale the moment the
+        # coordinator recomputes it; drop it now so the retried task
+        # re-adopts the rewritten manifest.
+        boundary = st.graph.stages[lost].boundary
+        if boundary is not None:
+            boundary.stage_invalidate(st.ctx)
+        st.gens.pop(lost, None)
+        return lost
+
+    # -- main loop ------------------------------------------------------------
+    def run(self) -> int:
+        from spark_rapids_tpu import monitoring
+        from spark_rapids_tpu.parallel.transport.rendezvous import \
+            RendezvousUnavailableError
+        _drop_remote_plugins()
+        # Trace exports from this process name their tracks after the
+        # worker, so side-by-side per-process traces stay attributable.
+        monitoring.set_process_tag(f"worker {self.wid}")
+        self.register()
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              name=f"srt-worker-hb-{self.wid}",
+                              daemon=True)
+        hb.start()
+        _LOG.info("worker %s: registered with %s:%d", self.wid,
+                  self.addr[0], self.addr[1])
+        idle_since = time.monotonic()
+        # Hot-poll backoff: right after finishing a stage the next
+        # dispatch is usually imminent (the downstream stage just
+        # unblocked), so poll tightly; every consecutive empty poll
+        # doubles the sleep up to the configured interval, so workers
+        # sitting out a long foreign stage don't burn the core the
+        # busy worker needs. Fully idle workers (no loaded query) stay
+        # at the configured interval.
+        hot_s = min(self.poll_ms, 2) / 1000.0
+        poll_s = self.poll_ms / 1000.0
+        delay_s = poll_s
+        try:
+            while not self._stop.is_set():
+                known = ",".join(str(q) for q in self.queries) or "-"
+                try:
+                    resp = self._call(f"CPOLL {self.wid} {known}")
+                except RendezvousUnavailableError:
+                    _LOG.warning("worker %s: coordinator unreachable — "
+                                 "exiting", self.wid)
+                    return 1
+                parts = resp.split()
+                if parts and parts[0] == "CTASK":
+                    qid, sid, gen = (int(parts[1]), int(parts[2]),
+                                     int(parts[3]))
+                    pkl_path = base64.b64decode(parts[5]).decode()
+                    self.execute(qid, sid, gen, parts[4], pkl_path)
+                    idle_since = time.monotonic()
+                    delay_s = hot_s
+                    continue
+                if parts and parts[0] == "CIDLE" and parts[1] != "-":
+                    for q in parts[1].split(","):
+                        if q:
+                            self._close_query(int(q))
+                if self.max_idle_s and \
+                        time.monotonic() - idle_since > self.max_idle_s:
+                    _LOG.info("worker %s: idle %.0fs — exiting",
+                              self.wid, self.max_idle_s)
+                    return 0
+                if self.queries:
+                    time.sleep(delay_s)
+                    delay_s = min(delay_s * 2, poll_s)
+                else:
+                    delay_s = poll_s
+                    time.sleep(poll_s)
+            return 0
+        finally:
+            self._stop.set()
+            for qid in list(self.queries):
+                self._close_query(qid)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="spark-rapids-tpu cluster worker")
+    ap.add_argument("--coordinator", required=True,
+                    help="host:port of the driver's cluster rendezvous")
+    ap.add_argument("--worker-id", required=True)
+    ap.add_argument("--poll-ms", type=int, default=25)
+    ap.add_argument("--heartbeat-ms", type=int, default=2000)
+    ap.add_argument("--max-idle-s", type=float, default=0.0,
+                    help="exit after this long without a task (0=never)")
+    ap.add_argument("--log-level", default="INFO")
+    a = ap.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, a.log_level.upper(), logging.INFO),
+        format=f"%(asctime)s {a.worker_id} %(levelname)s %(message)s")
+    host, _, port = a.coordinator.rpartition(":")
+    w = Worker((host or "127.0.0.1", int(port)), a.worker_id,
+               poll_ms=a.poll_ms, heartbeat_ms=a.heartbeat_ms,
+               max_idle_s=a.max_idle_s)
+    signal.signal(signal.SIGTERM, lambda *_: w.stop())
+    return w.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

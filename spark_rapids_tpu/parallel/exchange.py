@@ -252,6 +252,16 @@ class ShuffleExchangeExec(Exec):
         import os
 
         from spark_rapids_tpu.parallel import transport as T
+        info = ctx.cache.get("cluster")
+        if info is not None:
+            # Cluster mode (parallel/cluster/): a dispatchable stage's
+            # output lives at its cross-process tag on the query spool,
+            # shared by every process of the query. Untagged exchanges
+            # (session_for -> None) open their configured transport
+            # exactly as before.
+            sess = info.session_for(ctx, self)
+            if sess is not None:
+                return sess
         transport = T.materialization_transport(ctx.conf)
         return transport.open(
             ctx.conf, f"x{os.getpid():x}-{id(self):x}",
@@ -263,6 +273,22 @@ class ShuffleExchangeExec(Exec):
         if key in ctx.cache:
             return ctx.cache[key]
         from spark_rapids_tpu import monitoring
+        info = ctx.cache.get("cluster")
+        if info is not None and info.is_remote(self):
+            # Another process of this query produced (or is assigned)
+            # this stage: adopt its committed spool instead of running
+            # the map side. The dispatch barrier (QueryRun.run) and the
+            # coordinator's deps-done gating guarantee the manifest is
+            # committed before any consumer lands here.
+            with monitoring.span("exchange-adopt", "shuffle",
+                                 args={"op": self.name,
+                                       "stage": info.sid_of(self)}):
+                sess = info.session_for(ctx, self)
+                rows = type(info).adopt_manifest(
+                    sess, self.partitioning.num_partitions)
+                ctx.cache[key] = sess
+                ctx.cache[key + ":rows"] = rows
+                return sess
         with monitoring.span("exchange-materialize", "shuffle",
                              args={"op": self.name,
                                    "partitions":

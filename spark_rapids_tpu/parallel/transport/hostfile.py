@@ -114,13 +114,28 @@ class HostFileSession(ShuffleSession):
         self.worker = str(conf.get(
             C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID) or "") \
             or f"w{os.getpid()}"
-        self.expected_workers = max(int(conf.get(
+        # Exclusive-manifest mode (cluster stage outputs): ONE tag-scoped
+        # manifest published by whichever worker computed the stage —
+        # commit() atomically REPLACES it, so a recompute on a different
+        # worker can never leave a fetcher a mix of old and new shards.
+        self.exclusive = bool(conf.get(
+            C.SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST))
+        self.expected_workers = 1 if self.exclusive else max(int(conf.get(
             C.SHUFFLE_TRANSPORT_HOSTFILE_EXPECTED_WORKERS)), 1)
         self.fetch_timeout_ms = int(conf.get(
             C.SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS))
         from spark_rapids_tpu.parallel.transport import rendezvous as RV
         self._rv_addr = RV.parse_addr(str(conf.get(
             C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS) or ""))
+        self._rv_params = RV.client_params(conf)
+        # Cluster session roles (parallel/cluster/): a fetch-only
+        # session consumes another process's stage output — its
+        # invalidate/close must never delete the producer's spool data;
+        # keep_on_close additionally preserves THIS session's published
+        # output past ctx.close() (the coordinator owns query-end spool
+        # cleanup, not the producing worker's context teardown).
+        self.fetch_only = False
+        self.keep_on_close = False
         self.root = os.path.join(base, tag)
         self._my_dir = os.path.join(self.root, self.worker)
         self._seq: Dict[int, int] = {}
@@ -130,6 +145,11 @@ class HostFileSession(ShuffleSession):
         # Fetch-side caches: worker manifests + per-partition handles.
         self._manifests: Optional[List[dict]] = None
         self._handles: Dict[int, List[HostFileShardHandle]] = {}
+
+    def _manifest_path(self, worker: Optional[str] = None) -> str:
+        name = "exchange.manifest.json" if self.exclusive else \
+            f"{worker or self.worker}.manifest.json"
+        return os.path.join(self.root, name)
 
     # -- map side ------------------------------------------------------------
     def write_shard(self, partition: int, batch) -> None:
@@ -166,16 +186,35 @@ class HostFileSession(ShuffleSession):
                     "num_partitions": self.num_partitions,
                     "shards": {str(p): entries
                                for p, entries in self._written.items()}}
-        path = os.path.join(self.root, f"{self.worker}.manifest.json")
-        tmp = path + ".tmp"
+        path = self._manifest_path()
+        tmp = path + f".{self.worker}.tmp"
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(manifest, f)
+        # os.replace is the atomicity contract: a concurrent fetcher
+        # sees the previous complete manifest or this complete manifest,
+        # never a torn or merged one — in exclusive mode this is what
+        # makes a stage recompute on a different worker REPLACE the dead
+        # worker's shard set wholesale.
         os.replace(tmp, path)
         self._committed = True
         if self._rv_addr is not None:
             from spark_rapids_tpu.parallel.transport import rendezvous \
                 as RV
-            RV.announce_commit(self._rv_addr, self.tag, self.worker)
+            timeout_s, retries, backoff = self._rv_params
+            try:
+                RV.announce_commit(self._rv_addr, self.tag, self.worker,
+                                   timeout_s=timeout_s, retries=retries,
+                                   backoff_ms=backoff)
+            except RV.RendezvousUnavailableError as e:
+                # The manifest is already durable on the spool; a dead
+                # rendezvous only loses the event wait, so fetchers
+                # degrade to directory polling instead of this commit
+                # failing the query.
+                from spark_rapids_tpu.parallel import transport as T
+                T.record("rendezvousDegraded")
+                _LOG.warning("rendezvous unavailable at commit "
+                             "(degrading fetchers to manifest "
+                             "polling): %s", e)
 
     # -- reduce side ---------------------------------------------------------
     def _load_manifests(self) -> List[dict]:
@@ -184,9 +223,20 @@ class HostFileSession(ShuffleSession):
         if self._rv_addr is not None:
             from spark_rapids_tpu.parallel.transport import rendezvous \
                 as RV
-            RV.wait_committed(self._rv_addr, self.tag,
-                              self.expected_workers,
-                              self.fetch_timeout_ms)
+            timeout_s, retries, backoff = self._rv_params
+            try:
+                RV.wait_committed(self._rv_addr, self.tag,
+                                  self.expected_workers,
+                                  self.fetch_timeout_ms,
+                                  connect_timeout_s=timeout_s,
+                                  retries=retries, backoff_ms=backoff)
+            except RV.RendezvousUnavailableError as e:
+                # Degrade to directory polling below — the spool is the
+                # source of truth; the rendezvous only saves the poll.
+                from spark_rapids_tpu.parallel import transport as T
+                T.record("rendezvousDegraded")
+                _LOG.warning("rendezvous unavailable at fetch "
+                             "(degrading to manifest polling): %s", e)
         deadline = time.monotonic() + self.fetch_timeout_ms / 1000.0
         manifests: List[dict] = []
         while True:
@@ -197,6 +247,8 @@ class HostFileSession(ShuffleSession):
                 names = []
             for name in names:
                 if not name.endswith(".manifest.json"):
+                    continue
+                if self.exclusive and name != "exchange.manifest.json":
                     continue
                 try:
                     with open(os.path.join(self.root, name),
@@ -295,8 +347,14 @@ class HostFileSession(ShuffleSession):
 
     def invalidate(self) -> None:
         """Drop the WHOLE durable output (stage recompute contract): a
-        recompute rewrites every worker's shards under the same tag."""
+        recompute rewrites every worker's shards under the same tag. A
+        fetch-only session (cluster consumer of another process's stage
+        output) drops only its LOCAL handle/manifest caches — deleting
+        the producer's spool data is the coordinator's call, never a
+        consumer's."""
         self._close_handles()
+        if self.fetch_only:
+            return
         shutil.rmtree(self.root, ignore_errors=True)
         self._written = {}
         self._seq = {}
@@ -305,14 +363,22 @@ class HostFileSession(ShuffleSession):
     def close(self) -> None:
         """Query teardown: release fetched handles and remove what THIS
         worker wrote. Other workers' spool data survives — their
-        sessions own it (cross-process fetches may still be running)."""
+        sessions own it (cross-process fetches may still be running).
+        keep_on_close sessions (cluster stage outputs) release handles
+        only: the published spool output outlives this context, and the
+        coordinator removes the query's spool tree at query end."""
         self._close_handles()
+        if self.fetch_only or self.keep_on_close:
+            return
         shutil.rmtree(self._my_dir, ignore_errors=True)
-        try:
-            os.remove(os.path.join(self.root,
-                                   f"{self.worker}.manifest.json"))
-        except OSError:
-            pass
+        if self._committed or not self.exclusive:
+            # Only a committed manifest is ours to retract: in exclusive
+            # mode the single manifest may belong to ANOTHER worker's
+            # commit, which an uncommitted session must never delete.
+            try:
+                os.remove(self._manifest_path())
+            except OSError:
+                pass
         try:
             os.rmdir(self.root)   # last worker out turns off the lights
         except OSError:

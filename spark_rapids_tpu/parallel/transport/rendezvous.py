@@ -18,6 +18,20 @@ Wire protocol (UTF-8 lines, one request per connection):
 The server is a few dozen lines on purpose: it coordinates, it never
 carries shard bytes, and losing it only degrades fetchers back to
 manifest polling.
+
+Hardening (ISSUE 13 satellite): every client round trip runs under a
+connect/read timeout with a bounded retry + deterministic exponential
+backoff, so a dead rendezvous peer fails FAST with the typed
+:class:`RendezvousUnavailableError` (message-prefixed ``UNAVAILABLE:``,
+which ``memory/oom.is_transient_error`` maps onto the recovery
+ladder's transient rung) instead of hanging a fetch indefinitely. The
+accept side gets a read timeout too, so a half-open client can never
+pin a handler thread.
+
+Subclassing: unknown verbs are delegated to ``server.dispatch_extra``
+— the cluster control plane (parallel/cluster/coordinator.py) extends
+this exact server with stage-task verbs so workers "register with the
+rendezvous" through one socket and one wire grammar.
 """
 
 from __future__ import annotations
@@ -26,7 +40,26 @@ import socket
 import socketserver
 import threading
 import time
-from typing import Dict, Optional, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
+
+_BACKOFF_CAP_S = 2.0
+
+
+class RendezvousUnavailableError(ConnectionError):
+    """A rendezvous peer was unreachable within the bounded retry
+    schedule. The ``UNAVAILABLE:`` prefix makes it a transient error to
+    the recovery ladder (memory/oom.is_transient_error); the hostfile
+    transport additionally catches it and degrades to manifest-file
+    polling instead of failing the fetch."""
+
+    def __init__(self, addr: Tuple[str, int], attempts: int,
+                 last: BaseException):
+        super().__init__(
+            f"UNAVAILABLE: rendezvous {addr[0]}:{addr[1]} unreachable "
+            f"after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}")
+        self.addr = addr
+        self.attempts = attempts
 
 
 class _State:
@@ -36,6 +69,10 @@ class _State:
 
 
 class _Handler(socketserver.StreamRequestHandler):
+    # A dead/half-open client times out its read instead of pinning a
+    # handler thread forever (accept-side hardening).
+    timeout = 30.0
+
     def handle(self):
         state: _State = self.server.state        # type: ignore[attr-defined]
         line = self.rfile.readline().decode("utf-8", "replace").strip()
@@ -69,7 +106,11 @@ class _Handler(socketserver.StreamRequestHandler):
             ok = b"OK" if k >= n else b"TIMEOUT"
             self.wfile.write(ok + f" {k}\n".encode())
         else:
-            self.wfile.write(b"ERR\n")
+            # Protocol extension point: a subclassed server (the cluster
+            # coordinator) serves its extra verbs here; the base server
+            # answers ERR exactly as before.
+            resp = self.server.dispatch_extra(parts)    # type: ignore
+            self.wfile.write(b"ERR\n" if resp is None else resp)
 
 
 class RendezvousServer:
@@ -77,15 +118,21 @@ class RendezvousServer:
     pass port 0 to let the OS pick one (tests)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._srv = socketserver.ThreadingTCPServer(
+        srv = self._srv = socketserver.ThreadingTCPServer(
             (host, port), _Handler, bind_and_activate=True)
-        self._srv.daemon_threads = True
-        self._srv.state = _State()            # type: ignore[attr-defined]
-        self.addr: Tuple[str, int] = self._srv.server_address[:2]
+        srv.daemon_threads = True
+        srv.state = _State()                  # type: ignore[attr-defined]
+        srv.dispatch_extra = self.dispatch_extra  # type: ignore
+        self.addr: Tuple[str, int] = srv.server_address[:2]
         self._thread = threading.Thread(
-            target=self._srv.serve_forever, name="srt-rendezvous",
+            target=srv.serve_forever, name="srt-rendezvous",
             daemon=True)
         self._thread.start()
+
+    def dispatch_extra(self, parts: List[str]) -> Optional[bytes]:
+        """Handle one non-base verb; None = unknown (client gets ERR).
+        Subclasses (parallel/cluster/coordinator.py) override."""
+        return None
 
     def close(self) -> None:
         self._srv.shutdown()
@@ -93,11 +140,29 @@ class RendezvousServer:
 
 
 def _roundtrip(addr: Tuple[str, int], line: str,
-               timeout_s: float = 10.0) -> str:
-    with socket.create_connection(addr, timeout=timeout_s) as s:
-        s.sendall(line.encode("utf-8"))
-        f = s.makefile("rb")
-        return f.readline().decode("utf-8", "replace").strip()
+               timeout_s: float = 10.0, retries: int = 3,
+               backoff_ms: int = 50) -> str:
+    """One request/response round trip with bounded retry.
+
+    ``timeout_s`` bounds the connect AND the response read of each
+    attempt; a refused/timed-out attempt backs off deterministically
+    (``backoff_ms * 2^i``, capped) and retries up to ``retries`` extra
+    times before raising :class:`RendezvousUnavailableError`.
+    """
+    attempts = max(int(retries), 0) + 1
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        if i:
+            time.sleep(min(backoff_ms * (2 ** (i - 1)) / 1000.0,
+                           _BACKOFF_CAP_S))
+        try:
+            with socket.create_connection(addr, timeout=timeout_s) as s:
+                s.sendall(line.encode("utf-8"))
+                f = s.makefile("rb")
+                return f.readline().decode("utf-8", "replace").strip()
+        except (OSError, socket.timeout) as e:
+            last = e
+    raise RendezvousUnavailableError(addr, attempts, last)
 
 
 def parse_addr(spec: str) -> Optional[Tuple[str, int]]:
@@ -108,13 +173,31 @@ def parse_addr(spec: str) -> Optional[Tuple[str, int]]:
     return (host or "127.0.0.1", int(port))
 
 
-def announce_commit(addr: Tuple[str, int], tag: str, worker: str) -> None:
-    _roundtrip(addr, f"COMMIT {tag} {worker}\n")
+def client_params(conf) -> Tuple[float, int, int]:
+    """(timeout_s, retries, backoff_ms) for one round trip, from the
+    hostfile.rendezvous.* hardening keys."""
+    from spark_rapids_tpu import config as C
+    return (max(int(conf.get(
+                C.SHUFFLE_TRANSPORT_HOSTFILE_RV_CONNECT_TIMEOUT_MS)),
+                1) / 1000.0,
+            max(int(conf.get(C.SHUFFLE_TRANSPORT_HOSTFILE_RV_RETRIES)),
+                0),
+            max(int(conf.get(
+                C.SHUFFLE_TRANSPORT_HOSTFILE_RV_BACKOFF_MS)), 1))
+
+
+def announce_commit(addr: Tuple[str, int], tag: str, worker: str,
+                    timeout_s: float = 10.0, retries: int = 3,
+                    backoff_ms: int = 50) -> None:
+    _roundtrip(addr, f"COMMIT {tag} {worker}\n", timeout_s=timeout_s,
+               retries=retries, backoff_ms=backoff_ms)
 
 
 def wait_committed(addr: Tuple[str, int], tag: str, n: int,
-                   timeout_ms: int) -> bool:
+                   timeout_ms: int, connect_timeout_s: float = 10.0,
+                   retries: int = 3, backoff_ms: int = 50) -> bool:
     """Block until ``n`` workers committed ``tag``; False on timeout."""
     resp = _roundtrip(addr, f"WAIT {tag} {n} {timeout_ms}\n",
-                      timeout_s=timeout_ms / 1000.0 + 10.0)
+                      timeout_s=timeout_ms / 1000.0 + connect_timeout_s,
+                      retries=retries, backoff_ms=backoff_ms)
     return resp.startswith("OK")

@@ -540,6 +540,15 @@ class PhysicalPlan:
         graph = None
         if owned and bool(self.conf.get(C.STAGE_RECOVERY_ENABLED)):
             graph = S.build_stage_graph(self.root)
+        # Cluster mode (parallel/cluster/, ISSUE 13): dispatch the
+        # stage DAG to registered worker processes and fetch their
+        # committed outputs locally. None (disabled, no dispatchable
+        # stage, unpicklable plan, host fallback, mesh transport) =
+        # execute locally exactly as before.
+        qrun = None
+        if owned and bool(self.conf.get(C.CLUSTER_ENABLED)):
+            from spark_rapids_tpu.parallel import cluster as CL
+            qrun = CL.maybe_prepare(self, ctx, graph)
         stage_budget = max(
             int(self.conf.get(C.RECOVERY_MAX_STAGE_RECOMPUTES)), 0)
         stage_recomputes = 0
@@ -550,6 +559,13 @@ class PhysicalPlan:
         try:
             while True:
                 try:
+                    if qrun is not None:
+                        # Dispatch barrier: every remote stage task is
+                        # committed to the spool before the local
+                        # collect starts fetching. Dispatch failures
+                        # (worker exhaustion, timeout) unwind through
+                        # the same ladder below.
+                        qrun.run(ctx)
                     return self.root.collect(ctx,
                                              device=self.root_on_device)
                 except Exception as e:
@@ -569,6 +585,11 @@ class PhysicalPlan:
                     if st is not None and stage_recomputes < stage_budget:
                         S.invalidate_stage(ctx, st)
                         S.record_recompute(ctx, st)
+                        if qrun is not None:
+                            # The lost output is a REMOTE stage's spool:
+                            # requeue its task so a worker rewrites it
+                            # before the re-collect fetches again.
+                            qrun.recompute(st.stage_id)
                         stage_recomputes += 1
                         log.warning(
                             "lost stage output (%s, recompute %d/%d); "
@@ -599,12 +620,16 @@ class PhysicalPlan:
                             "%.0fms: %s",
                             attempt + 1, max_retries, delay_ms, e)
                         _time.sleep(delay_ms / 1000.0)
+                        if qrun is not None:
+                            qrun.reset()
                         ctx.close()
                         ctx = ExecContext(self.conf, query=ticket)
                         install_bindings(ctx)
                         ctx.cache.setdefault("trace_query", trace_qid)
                         if ticket is not None:
                             mgr.register_context(ticket, ctx)
+                        if qrun is not None:
+                            qrun.install(ctx)
                     rec = query_metrics_entry(ctx, "Recovery")
                     rec.add("retriesAttempted", 1)
                     attempt += 1
@@ -629,6 +654,11 @@ class PhysicalPlan:
                             qid=trace_qid)
                 faults.set_query_token(None)
                 mgr.finish(ticket)
+            if qrun is not None:
+                # Retire the dispatch state and the query's spool tree
+                # BEFORE the context close: sessions opened on it are
+                # keep_on_close, so the coordinator owns this cleanup.
+                qrun.finish()
             # Metrics survive the collect for DataFrame.metrics().
             self.last_ctx = ctx
             if owned:

@@ -1,0 +1,582 @@
+"""Distributed worker runtime tests (ISSUE 13): stage-DAG partitioning,
+pull-based locality scheduling, elastic membership, worker-death
+recovery (SIGKILL chaos = exactly ONE stage recompute), exclusive-
+manifest replacement semantics, and rendezvous client hardening.
+
+Process-level tests launch real workers via
+``python -m spark_rapids_tpu.parallel.cluster.worker`` and assert the
+cluster result is BIT-IDENTICAL to the single-process run — the same
+equality contract every other engine feature is held to.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import spark_rapids_tpu
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.memory.oom import is_transient_error
+from spark_rapids_tpu.parallel import cluster as CL
+from spark_rapids_tpu.parallel import transport as T
+from spark_rapids_tpu.parallel.cluster import coordinator as CO
+from spark_rapids_tpu.parallel.transport import rendezvous as RV
+from spark_rapids_tpu.parallel.transport.hostfile import HostFileTransport
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.abspath(spark_rapids_tpu.__file__)))
+
+
+@pytest.fixture(autouse=True)
+def clean_cluster_state():
+    faults.configure("")
+    faults.reset_counters()
+    yield
+    CL.shutdown_coordinator()
+    faults.configure("")
+    faults.reset_counters()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_cluster"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=7)
+    return d
+
+
+def _session(**over) -> TpuSession:
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    for k, v in over.items():
+        s.set(k, v)
+    return s
+
+
+def _cluster_session(**over) -> TpuSession:
+    s = _session()
+    s.set("spark.rapids.sql.cluster.enabled", True)
+    for k, v in over.items():
+        s.set(k, v)
+    return s
+
+
+def _spawn_worker(addr: str, wid: str, extra_env=None, heartbeat_ms=None):
+    cmd = [sys.executable, "-m",
+           "spark_rapids_tpu.parallel.cluster.worker",
+           "--coordinator", addr, "--worker-id", wid]
+    if heartbeat_ms is not None:
+        cmd += ["--heartbeat-ms", str(heartbeat_ms)]
+    env = dict(os.environ)
+    env.pop("SRT_FAULTS", None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(cmd, env=env, cwd=REPO_ROOT)
+
+
+def _stop(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except Exception:
+            p.kill()
+
+
+def _dead_addr():
+    """An address nothing listens on (bound once, then released)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return ("127.0.0.1", port)
+
+
+# ---------------------------------------------------------------------------
+# Stage-DAG partitioning
+# ---------------------------------------------------------------------------
+
+class TestStagePlan:
+    def test_q3_dispatchable_stages_and_deps(self, data_dir):
+        from spark_rapids_tpu.parallel.exchange import ShuffleExchangeExec
+        s = _session()
+        s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        phys = tpch.QUERIES["q3"](s, data_dir)._physical()
+        g, disp, deps = CO.stage_plan(phys.root)
+        assert disp, "shuffle-forced q3 must have dispatchable stages"
+        for sid in disp:
+            assert isinstance(g.stages[sid].boundary, ShuffleExchangeExec)
+        # The dep map only ever names dispatchable producers, and flows
+        # transitively THROUGH non-dispatchable stages: q3's aggregate
+        # exchange consumes join output, so at least one dispatchable
+        # stage depends on another.
+        for sid in disp:
+            assert deps[sid] <= disp
+        assert any(deps[sid] for sid in disp)
+
+    def test_broadcast_stage_not_dispatchable_deps_flow_through(self):
+        from spark_rapids_tpu.columnar import dtypes as dt
+        from spark_rapids_tpu.parallel import SinglePartitioning
+        from spark_rapids_tpu.parallel.exchange import (
+            BroadcastExchangeExec, ShuffleExchangeExec)
+        from test_ops import source
+        src = source([("a", dt.INT64)], {"a": [1, 2, 3]})
+        inner = ShuffleExchangeExec(src, SinglePartitioning())
+        bx = BroadcastExchangeExec(inner)
+        top = ShuffleExchangeExec(bx, SinglePartitioning())
+        g, disp, deps = CO.stage_plan(top)
+        bsid = g.by_exchange[id(bx)]
+        isid = g.by_exchange[id(inner)]
+        # Broadcast stages compute locally in every process (Spark
+        # broadcast semantics) — never dispatched; their shuffle deps
+        # flow THROUGH to whoever consumes the broadcast.
+        assert bsid not in disp and isid in disp
+        assert deps[bsid] == {isid}
+        # the root exchange's own dispatchable stage sees the inner
+        # shuffle THROUGH the broadcast stage between them
+        tsid = g.by_exchange[id(top)]
+        assert tsid in disp and deps[tsid] == {isid}
+
+
+# ---------------------------------------------------------------------------
+# Coordinator protocol: in-process verb-level tests (no worker processes)
+# ---------------------------------------------------------------------------
+
+def _submit_q3(data_dir, **over):
+    s = _cluster_session(**over)
+    s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    phys = tpch.QUERIES["q3"](s, data_dir)._physical()
+    co = CL.get_coordinator(s.conf)
+    q = co.submit(phys, s.conf)
+    assert q is not None
+    return co, q
+
+
+class TestCoordinatorProtocol:
+    def test_register_poll_done_cycle(self, data_dir):
+        co, q = _submit_q3(data_dir)
+        assert co.dispatch(["CREG", "wA"]) == b"OK\n"
+        seen = []
+        while True:
+            resp = co.dispatch(["CPOLL", "wA", "-"]).decode().split()
+            if resp[0] == "CIDLE":
+                break
+            assert resp[0] == "CTASK"
+            qid, sid, gen = int(resp[1]), int(resp[2]), int(resp[3])
+            assert qid == q.qid and gen == 0
+            # every dep of a dispatched task is already committed
+            for d in q.tasks[sid].deps:
+                assert q.tasks[d].status == "done"
+            assert base64.b64decode(resp[5]).decode() == q.pkl_path
+            assert co.dispatch(
+                ["CDONE", "wA", str(qid), str(sid), str(gen),
+                 "100"]) == b"OK\n"
+            seen.append(sid)
+        assert sorted(seen) == sorted(q.tasks)
+        assert all(t.status == "done" and t.producer == "wA"
+                   for t in q.tasks.values())
+
+    def test_min_workers_gate_and_late_joiner_gets_work(self, data_dir):
+        co, q = _submit_q3(
+            data_dir, **{"spark.rapids.sql.cluster.minWorkers": 2})
+        co.dispatch(["CREG", "wA"])
+        resp = co.dispatch(["CPOLL", "wA", "-"]).decode()
+        assert resp.startswith("CIDLE")       # gate closed at 1 worker
+        co.dispatch(["CREG", "wB"])           # elastic late join
+        # The joiner picks up queued work — possibly after waiting out
+        # the steal-delay reservation on stages whose rendezvous-hash
+        # owner is the (idle) incumbent.
+        deadline = time.time() + 2.0
+        while True:
+            resp = co.dispatch(["CPOLL", "wB", "-"]).decode()
+            if resp.startswith("CTASK") or time.time() > deadline:
+                break
+            time.sleep(0.02)
+        assert resp.startswith("CTASK")
+        assert q.tasks[int(resp.split()[2])].worker == "wB"
+
+    def test_stale_generation_commit_ignored(self, data_dir):
+        co, q = _submit_q3(data_dir)
+        co.dispatch(["CREG", "wA"])
+        resp = co.dispatch(["CPOLL", "wA", "-"]).decode().split()
+        sid = int(resp[2])
+        with co._lock:                        # worker declared dead
+            q._requeue_locked(q.tasks[sid], "test-induced")
+        # the zombie's late commit carries the old generation: ignored
+        co.dispatch(["CDONE", "wA", str(q.qid), str(sid), "0", "77"])
+        t = q.tasks[sid]
+        assert t.status == "pending" and t.gen == 1 and t.retries == 1
+        assert t.producer is None
+
+    def test_locality_prefers_shard_holder(self):
+        conf = _cluster_session().conf
+        co = CL.get_coordinator(conf)
+        tasks = {1: CO._StageTask(1, set()), 2: CO._StageTask(2, set()),
+                 3: CO._StageTask(3, {1}), 4: CO._StageTask(4, {2})}
+        q = CO.QueryRun(co, 99, conf, tasks, {})
+        with co._lock:
+            co.queries[99] = q
+            co._touch_locked("wA")
+            co._touch_locked("wB")
+            for sid, wid in ((1, "wA"), (2, "wB")):
+                t = tasks[sid]
+                t.status, t.producer, t.bytes = "done", wid, 1000
+            # each worker is offered the consumer of ITS OWN shards
+            _, picked_a = q._pick_locked("wA")
+            assert picked_a.sid == 3
+            _, picked_b = q._pick_locked("wB")
+            assert picked_b.sid == 4
+            co.queries.pop(99)
+
+    def test_score_ties_prefer_hrw_owner(self):
+        # Leaf stages (no input shards yet) all score 0: the tie must
+        # break to the stage's rendezvous-hash owner, not to whichever
+        # worker polls first — repeat queries then land every stage on
+        # the same process, keeping its kernel traces hot.
+        conf = _cluster_session().conf
+        co = CL.get_coordinator(conf)
+        tasks = {s: CO._StageTask(s, set()) for s in range(1, 9)}
+        q = CO.QueryRun(co, 98, conf, tasks, {})
+        with co._lock:
+            co.queries[98] = q
+            co._touch_locked("wA")
+            co._touch_locked("wB")
+            owners = {s: CO._hrw_owner(["wA", "wB"], s) for s in tasks}
+            by_owner = {w: sorted(s for s, o in owners.items() if o == w)
+                        for w in ("wA", "wB")}
+            assert by_owner["wA"] and by_owner["wB"]
+            for wid in ("wA", "wB"):
+                for expect in by_owner[wid]:
+                    _, picked = q._pick_locked(wid)
+                    assert picked.sid == expect, (wid, by_owner)
+            # every stage went to its owner; nothing left to steal
+            assert q._pick_locked("wA") is None
+            co.queries.pop(98)
+
+    def test_steal_delay_reserves_task_for_preferred_worker(self):
+        # Delay scheduling: a ready task is reserved for its preferred
+        # worker for stealDelayMs, so a momentarily busy worker keeps
+        # its stages (and its kernel traces) instead of losing them to
+        # whichever idle process polls first. After the reservation
+        # expires any worker may take it (work conservation).
+        conf = _cluster_session().conf
+        co = CL.get_coordinator(conf)
+        sid = next(s for s in range(1, 50)
+                   if CO._hrw_owner(["wA", "wB"], s) == "wA")
+        q = CO.QueryRun(co, 97, conf, {sid: CO._StageTask(sid, set())},
+                        {})
+        assert q.steal_delay_s > 0    # default reservation is on
+        with co._lock:
+            co.queries[97] = q
+            co._touch_locked("wA")
+            co._touch_locked("wB")
+            assert q._pick_locked("wB") is None     # reserved for wA
+            t = q.tasks[sid]
+            assert t.status == "pending" and t.ready_ts is not None
+            t.ready_ts -= q.steal_delay_s + 1.0     # reservation lapses
+            _, picked = q._pick_locked("wB")        # now stealable
+            assert picked.sid == sid and picked.worker == "wB"
+            co.queries.pop(97)
+
+    def test_retry_budget_exhaustion_fails_dispatch(self, data_dir):
+        co, q = _submit_q3(
+            data_dir, **{"spark.rapids.sql.cluster.maxTaskRetries": 1})
+        t = next(iter(q.tasks.values()))
+        with co._lock:
+            q._requeue_locked(t, "first")
+            assert q.error is None
+            q._requeue_locked(t, "second")
+            assert isinstance(q.error, CO.ClusterDispatchError)
+        co.dispatch(["CREG", "wA"])
+        resp = co.dispatch(["CPOLL", "wA", "-"]).decode()
+        assert resp.startswith("CIDLE")       # failed query stops dispatch
+
+    def test_poll_reports_stale_queries(self, data_dir):
+        co, q = _submit_q3(data_dir)
+        co.dispatch(["CREG", "wA"])
+        q.finish()                            # query retired
+        resp = co.dispatch(["CPOLL", "wA", str(q.qid)]).decode().split()
+        assert resp[0] == "CIDLE" and str(q.qid) in resp[1].split(",")
+
+
+# ---------------------------------------------------------------------------
+# Exclusive-manifest replacement (the recompute-republication bugfix pin)
+# ---------------------------------------------------------------------------
+
+def _exclusive_conf(tmp_path, wid, **over):
+    raw = {C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key: str(tmp_path),
+           C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID.key: wid,
+           C.SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST.key: True}
+    raw.update({getattr(C, k).key: v for k, v in over.items()})
+    return C.TpuConf(raw)
+
+
+def _kv_batch(keys, vals):
+    import numpy as np
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.host import (HostBatch, HostColumn,
+                                                host_to_device)
+    return host_to_device(HostBatch(
+        ("k", "v"),
+        [HostColumn(dt.INT64, np.asarray(keys, np.int64),
+                    np.ones(len(keys), bool)),
+         HostColumn(dt.INT64, np.asarray(vals, np.int64),
+                    np.ones(len(vals), bool))]))
+
+
+def _rows(handles):
+    from spark_rapids_tpu.columnar.host import device_to_host
+    return [row for h in handles for row in device_to_host(h.get())
+            .to_pylist()]
+
+
+class TestExclusiveManifest:
+    def test_recompute_commit_atomically_replaces_manifest(self, tmp_path):
+        w1 = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "dead"), "s5", 2, owner=1)
+        w1.write_shard(0, _kv_batch([1, 2], [10, 20]))
+        w1.write_shard(1, _kv_batch([3], [30]))
+        w1.commit()
+        path = os.path.join(str(tmp_path), "s5", "exchange.manifest.json")
+        with open(path, encoding="utf-8") as f:
+            assert json.load(f)["worker"] == "dead"
+        # The stage recomputes on a survivor: its commit must REPLACE the
+        # dead worker's manifest wholesale — never merge with it, so no
+        # fetcher can observe a mix of old and new shard sets.
+        w2 = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "survivor"), "s5", 2, owner=1)
+        w2.write_shard(0, _kv_batch([1, 2], [10, 20]))
+        w2.write_shard(1, _kv_batch([3], [30]))
+        w2.commit()
+        with open(path, encoding="utf-8") as f:
+            m = json.load(f)
+        assert m["worker"] == "survivor"
+        files = [e["file"] for es in m["shards"].values() for e in es]
+        assert files and all(f.startswith("survivor/") for f in files)
+        # exclusive mode: ONE tag-scoped manifest, not one per worker
+        names = [n for n in os.listdir(os.path.join(str(tmp_path), "s5"))
+                 if n.endswith(".manifest.json")]
+        assert names == ["exchange.manifest.json"]
+        r = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "reader"), "s5", 2, owner=1)
+        assert len(r._load_manifests()) == 1
+        assert _rows(r.fetch_shards(0)) == [(1, 10), (2, 20)]
+        assert _rows(r.fetch_shards(1)) == [(3, 30)]
+
+    def test_fetch_only_session_never_deletes_producer_spool(self,
+                                                             tmp_path):
+        w = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "prod"), "s1", 1, owner=1)
+        w.write_shard(0, _kv_batch([7], [70]))
+        w.commit()
+        r = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "cons"), "s1", 1, owner=1)
+        r.fetch_only = True
+        assert _rows(r.fetch_shards(0)) == [(7, 70)]
+        r.invalidate()
+        r.close()
+        # the producer's committed output must survive consumer teardown
+        r2 = HostFileTransport().open(
+            _exclusive_conf(tmp_path, "cons2"), "s1", 1, owner=1)
+        assert _rows(r2.fetch_shards(0)) == [(7, 70)]
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous client hardening (connect timeouts + bounded backoff)
+# ---------------------------------------------------------------------------
+
+class TestRendezvousHardening:
+    def test_unreachable_addr_fails_fast_typed_and_transient(self):
+        addr = _dead_addr()
+        t0 = time.monotonic()
+        with pytest.raises(RV.RendezvousUnavailableError) as ei:
+            RV._roundtrip(addr, "PING x y\n", timeout_s=0.2, retries=2,
+                          backoff_ms=10)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5.0                  # bounded, not a 90s hang
+        assert str(ei.value).startswith("UNAVAILABLE:")
+        assert "3 attempt" in str(ei.value)
+        # typed onto the recovery ladder: the planner's whole-query
+        # retry rung treats it as transient
+        assert is_transient_error(ei.value)
+
+    def test_client_params_read_hardening_keys(self):
+        conf = C.TpuConf({
+            C.SHUFFLE_TRANSPORT_HOSTFILE_RV_CONNECT_TIMEOUT_MS.key: 250,
+            C.SHUFFLE_TRANSPORT_HOSTFILE_RV_RETRIES.key: 5,
+            C.SHUFFLE_TRANSPORT_HOSTFILE_RV_BACKOFF_MS.key: 20})
+        assert RV.client_params(conf) == (0.25, 5, 20)
+
+    def test_commit_degrades_to_polling_when_rendezvous_dead(self,
+                                                             tmp_path):
+        host, port = _dead_addr()
+        conf = _exclusive_conf(
+            tmp_path, "w",
+            SHUFFLE_TRANSPORT_HOSTFILE_RV_CONNECT_TIMEOUT_MS=100,
+            SHUFFLE_TRANSPORT_HOSTFILE_RV_RETRIES=0)
+        raw = dict(conf.raw)
+        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS.key] = \
+            f"{host}:{port}"
+        conf = C.TpuConf(raw)
+        before = T.counters().get("rendezvousDegraded", 0)
+        w = HostFileTransport().open(conf, "sx", 1, owner=1)
+        w.write_shard(0, _kv_batch([1], [2]))
+        w.commit()                 # must not raise: manifest is durable
+        assert T.counters().get("rendezvousDegraded", 0) == before + 1
+        r = HostFileTransport().open(conf, "sx", 1, owner=1)
+        assert _rows(r.fetch_shards(0)) == [(1, 2)]
+
+
+# ---------------------------------------------------------------------------
+# Process-level: real workers, bit-identity, chaos, elasticity
+# ---------------------------------------------------------------------------
+
+FAST_QUERIES = ("q1", "q3")
+
+
+class TestClusterProcess:
+    def test_tpch_bit_identity_driver_plus_two_workers(self, data_dir):
+        s = _session()
+        want = {q: tpch.QUERIES[q](s, data_dir).collect()
+                for q in FAST_QUERIES}
+        sc = _cluster_session()
+        co = CL.get_coordinator(sc.conf)
+        addr = f"{co.addr[0]}:{co.addr[1]}"
+        procs = [_spawn_worker(addr, f"w{i}") for i in range(2)]
+        try:
+            for q in FAST_QUERIES:
+                assert tpch.QUERIES[q](sc, data_dir).collect() == want[q]
+            st = co.stats()["workers"]
+            assert {"w0", "w1"} <= set(st)
+            # at least one stage actually ran remotely across the queries
+            assert sum(w["completed"] for w in st.values()) >= 1
+        finally:
+            _stop(procs)
+
+    @pytest.mark.slow
+    def test_tpch_all_queries_bit_identical_two_workers(self, data_dir):
+        s = _session()
+        want = {q: tpch.QUERIES[q](s, data_dir).collect()
+                for q in sorted(tpch.QUERIES)}
+        sc = _cluster_session()
+        co = CL.get_coordinator(sc.conf)
+        addr = f"{co.addr[0]}:{co.addr[1]}"
+        procs = [_spawn_worker(addr, f"w{i}") for i in range(2)]
+        try:
+            for q in sorted(tpch.QUERIES):
+                assert tpch.QUERIES[q](sc, data_dir).collect() == \
+                    want[q], q
+        finally:
+            _stop(procs)
+
+    @pytest.mark.slow      # CI runs this via the worker-death entry
+    def test_sigkill_worker_death_exactly_one_stage_recompute(
+            self, data_dir):
+        s = _session()
+        want = tpch.QUERIES["q3"](s, data_dir).collect()
+        sc = _cluster_session(
+            **{"spark.rapids.sql.cluster.heartbeatTimeoutMs": 1500})
+        co = CL.get_coordinator(sc.conf)
+        addr = f"{co.addr[0]}:{co.addr[1]}"
+        # The armed worker starts ALONE so it deterministically receives
+        # the first stage task and SIGKILLs itself mid-stage; the
+        # survivor spawns only after the coordinator declared the death,
+        # so it can never steal the armed task first.
+        procs = [_spawn_worker(
+            addr, "w0", heartbeat_ms=500,
+            extra_env={"SRT_FAULTS": "workerdeath@cluster.stage:1"})]
+
+        def spawn_survivor():
+            while True:
+                st = co.stats()["workers"]
+                if "w0" in st and not st["w0"]["alive"]:
+                    break
+                time.sleep(0.05)
+            procs.append(_spawn_worker(addr, "w1", heartbeat_ms=500))
+
+        threading.Thread(target=spawn_survivor, daemon=True).start()
+        try:
+            c0 = dict(faults.counters())
+            got = tpch.QUERIES["q3"](sc, data_dir).collect()
+            c1 = faults.counters()
+            delta = lambda k: c1.get(k, 0) - c0.get(k, 0)
+            assert got == want                       # bit-identical
+            assert delta("clusterWorkerDeaths") == 1
+            assert delta("stageRecomputes") == 1     # ONE stage, not more
+            assert delta("retriesAttempted") == 0    # never a dead query
+            assert procs[0].wait(timeout=10) == -9   # really SIGKILLed
+        finally:
+            _stop(procs)
+
+    @pytest.mark.slow
+    def test_elastic_worker_joins_mid_run_and_unblocks_query(
+            self, data_dir):
+        s = _session()
+        want = tpch.QUERIES["q3"](s, data_dir).collect()
+        # minWorkers=3 with only two workers up: the dispatch gate holds
+        # every task, so the query can ONLY complete once the third
+        # worker joins mid-run — deterministic proof of elasticity.
+        sc = _cluster_session(
+            **{"spark.rapids.sql.cluster.minWorkers": 3})
+        co = CL.get_coordinator(sc.conf)
+        addr = f"{co.addr[0]}:{co.addr[1]}"
+        procs = [_spawn_worker(addr, f"w{i}") for i in range(2)]
+        result = {}
+
+        def run():
+            result["got"] = tpch.QUERIES["q3"](sc, data_dir).collect()
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        try:
+            while True:                 # both up, heartbeating, starved
+                st = co.stats()
+                if {"w0", "w1"} <= set(st["workers"]) and st["queries"]:
+                    break
+                time.sleep(0.05)
+            time.sleep(0.5)
+            assert th.is_alive()        # gate really held the dispatch
+            assert all(t["status"] == "pending"
+                       for q in co.stats()["queries"].values()
+                       for t in q.values())
+            procs.append(_spawn_worker(addr, "w2"))
+            th.join(timeout=180)
+            assert not th.is_alive() and result["got"] == want
+        finally:
+            _stop(procs)
+
+
+# ---------------------------------------------------------------------------
+# Stand-downs: cluster mode must be correct before it is clever
+# ---------------------------------------------------------------------------
+
+class TestStandDowns:
+    def test_disabled_by_default_no_coordinator(self, data_dir):
+        s = _session()
+        assert not CO.cluster_enabled(s.conf)
+        tpch.QUERIES["q1"](s, data_dir).collect()
+        assert CO._CO is None           # nothing cluster-side was built
+
+    def test_no_dispatchable_stage_stands_down(self):
+        # An exchange-free plan (scan+filter+project) has no shuffle
+        # stage to dispatch: the query must run locally — instantly,
+        # with zero workers registered — instead of waiting on the gate.
+        from spark_rapids_tpu.columnar import dtypes as dt
+        from spark_rapids_tpu.plan.logical import col
+        sc = _cluster_session(
+            **{"spark.rapids.sql.cluster.dispatchTimeoutMs": 2000})
+        df = sc.create_dataframe(
+            {"k": ["a", "b", "c"], "v": [1, 2, 3]},
+            [("k", dt.STRING), ("v", dt.INT32)])
+        got = df.filter(col("v") > 1).select("k").collect()
+        assert sorted(got) == [("b",), ("c",)]

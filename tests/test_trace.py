@@ -320,6 +320,26 @@ def test_trace_export_chrome_q3(data_dir, tmp_path):
             assert e["dur"] >= 0 and e["ts"] >= 0
 
 
+def test_process_tag_prefixes_exported_tracks():
+    # Cluster worker processes tag themselves (worker.py run()) so their
+    # per-process trace exports render "worker <wid> query N" tracks;
+    # the untagged driver keeps the plain "query N" names.
+    from spark_rapids_tpu.monitoring.chrome import to_chrome
+    evs = [("X", "stage", "cluster", 1_000, 2_000, 1, 3, None)]
+    try:
+        monitoring.set_process_tag("worker w7")
+        doc = to_chrome(evs, {1: "t"}, monitoring.process_tag())
+        names = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert names == ["worker w7 query 3"]
+    finally:
+        monitoring.set_process_tag("")
+    doc = to_chrome(evs, {1: "t"}, monitoring.process_tag())
+    names = [e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert names == ["query 3"]
+
+
 def test_snapshot_category_breakdown(data_dir):
     tpch.QUERIES["q6"](_session(), data_dir).collect()
     snap = monitoring.snapshot()

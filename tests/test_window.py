@@ -135,3 +135,88 @@ class TestWindowAggs:
         assert bykey[(1, 1)] == 1.0 and bykey[(1, 2)] == 1.5
         assert bykey[(1, 3)] == 1.5  # null adds nothing
         assert bykey[(2, 1)] == 8.0
+
+
+class TestStringWindows:
+    """String-typed window results (lead/lag + whole-partition min/max) —
+    device gathers the winner row's bytes instead of running strings
+    through the numeric kernel."""
+
+    SSCHEMA = [("g", dt.STRING), ("o", dt.INT32), ("s", dt.STRING)]
+    SDATA = {
+        "g": ["a", "a", "a", "b", "b", "a", "b", None, "c", "c",
+              "d", "d"],
+        "o": [1, 2, 3, 1, 2, 4, 3, 1, 1, 2, 1, 2],
+        "s": ["mango", None, "apple", "zeta", "kiwi", "pear", None,
+              "solo", None, None, "", "é"],
+    }
+
+    def test_string_lead_lag(self):
+        plan = WindowExec(
+            source(self.SSCHEMA, self.SDATA, batches_per_partition=2),
+            [WindowExprSpec("ld", Lead(Ref(2, dt.STRING), 1), wspec()),
+             WindowExprSpec("lg", Lag(Ref(2, dt.STRING), 1), wspec())])
+        out = compare_engines(plan, sort_result=True)
+        bykey = {(r[0], r[1]): tuple(r[3:]) for r in out}
+        # partition a ordered by o: mango, None, apple, pear
+        assert bykey[("a", 1)] == (None, None)
+        assert bykey[("a", 2)] == ("apple", "mango")
+        assert bykey[("a", 3)] == ("pear", None)
+        assert bykey[("a", 4)] == (None, "apple")
+        # partition b: zeta, kiwi, None
+        assert bykey[("b", 1)] == ("kiwi", None)
+        assert bykey[("b", 3)] == (None, "kiwi")
+        # null partition key is its own single-row partition
+        assert bykey[(None, 1)] == (None, None)
+
+    def test_string_whole_partition_minmax(self):
+        spec = WindowSpec([Ref(0, dt.STRING)], [])
+        frame = WindowFrame(None, None)
+        plan = WindowExec(
+            source(self.SSCHEMA, self.SDATA, batches_per_partition=3),
+            [WindowExprSpec("mn", WindowAgg(
+                "min", Ref(2, dt.STRING), frame), spec),
+             WindowExprSpec("mx", WindowAgg(
+                 "max", Ref(2, dt.STRING), frame), spec)])
+        out = compare_engines(plan, sort_result=True)
+        for r in out:
+            want = {"a": ("apple", "pear"), "b": ("kiwi", "zeta"),
+                    "c": (None, None),          # all-null partition
+                    "d": ("", "é"),        # empty + multibyte
+                    None: ("solo", "solo")}[r[0]]
+            assert tuple(r[3:]) == want, r
+
+    def test_string_window_datagen(self):
+        from data_gen import RepeatSeqGen, StringGen, gen_batch
+        b = gen_batch(
+            [("g", RepeatSeqGen(StringGen(), length=7)),
+             ("s", StringGen())], 120, seed=11)
+        rows = b.to_pylist()
+        data = {"g": [r[0] for r in rows],
+                # unique order keys: lead/lag with order ties is
+                # tie-break-dependent and not comparable across engines
+                "o": list(range(len(rows))),
+                "s": [r[1] for r in rows]}
+        spec = WindowSpec([Ref(0, dt.STRING)],
+                          [SortOrder(Ref(1, dt.INT32))])
+        pspec = WindowSpec([Ref(0, dt.STRING)], [])
+        plan = WindowExec(
+            source(self.SSCHEMA, data, batches_per_partition=3),
+            [WindowExprSpec("ld", Lead(Ref(2, dt.STRING), 1), spec),
+             WindowExprSpec("lg", Lag(Ref(2, dt.STRING), 2), spec),
+             WindowExprSpec("mn", WindowAgg(
+                 "min", Ref(2, dt.STRING), WindowFrame(None, None)),
+                 pspec),
+             WindowExprSpec("mx", WindowAgg(
+                 "max", Ref(2, dt.STRING), WindowFrame(None, None)),
+                 pspec)])
+        compare_engines(plan, sort_result=True)
+
+    def test_string_running_minmax_unsupported(self):
+        plan = WindowExec(
+            source(self.SSCHEMA, self.SDATA),
+            [WindowExprSpec("rm", WindowAgg(
+                "min", Ref(2, dt.STRING),
+                WindowFrame(None, 0, running_with_peers=True)), wspec())])
+        with pytest.raises(NotImplementedError):
+            plan.collect(device=True)
