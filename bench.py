@@ -290,12 +290,18 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         sh(s, i).collect()
     warmup_s = time.perf_counter() - t0
     c0 = _pc.counters()
+    from spark_rapids_tpu.parallel import qos as _qos
+    q0c = _qos.counters()
     lock = threading.Lock()
     lat: list = []
     idx = {"i": 0}
     errors = [0]
 
-    def client():
+    def client(k):
+        # Each client is a distinct serving tenant: the per-tenant
+        # plan-cache counters (parallel/qos/) attribute every hit/miss
+        # even with the QoS scheduler off.
+        tenant = f"client{k}"
         while True:
             with lock:
                 i = idx["i"]
@@ -304,7 +310,7 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
                 idx["i"] = i + 1
             q0 = time.perf_counter()
             try:
-                shapes[i % len(shapes)](s, i).collect()
+                shapes[i % len(shapes)](s, i).collect(tenant=tenant)
             except Exception:
                 with lock:
                     errors[0] += 1
@@ -314,7 +320,7 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
                 lat.append(took)
 
     t0 = time.perf_counter()
-    workers = [threading.Thread(target=client, daemon=True,
+    workers = [threading.Thread(target=client, args=(k,), daemon=True,
                                 name=f"srt-sustained-{k}")
                for k in range(clients)]
     for w in workers:
@@ -323,6 +329,7 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         w.join()
     wall = time.perf_counter() - t0
     c1 = _pc.counters()
+    q1c = _qos.counters()
     hits = c1.get("planCacheHits", 0) - c0.get("planCacheHits", 0)
     misses = c1.get("planCacheMisses", 0) - c0.get("planCacheMisses", 0)
     bind_ns = c1.get("planBindNs", 0) - c0.get("planBindNs", 0)
@@ -359,6 +366,120 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         "q6_replan_retrace_s": round(off_s, 4),
         "q6_speedup_vs_plan_cache_off": round(off_s / on_s, 2)
         if on_s > 0 else None,
+        "tenants": {
+            f"client{k}": {
+                "plan_cache_hits": int(
+                    q1c.get(f"planCacheHit.client{k}", 0)
+                    - q0c.get(f"planCacheHit.client{k}", 0)),
+                "plan_cache_misses": int(
+                    q1c.get(f"planCacheMiss.client{k}", 0)
+                    - q0c.get(f"planCacheMiss.client{k}", 0)),
+            } for k in range(clients)
+        },
+    }
+
+
+def _qos_probe(tpch_dir: str, total: int) -> dict:
+    """Serving QoS block (ISSUE 14; parallel/qos/): mixed-class
+    parameterized load through the WFQ scheduler at a deliberately
+    tight maxConcurrentQueries=2 with a lopsided weight vector and a
+    small starvation bound, plus a 2-client tenant capped at ONE
+    in-flight query. Reports per-class p50/p99 latency, rejections by
+    kind (the capped tenant produces real tenant-quota rejections),
+    starvation-bound engagements, and kernel-quota evictions."""
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.parallel import qos as _qos
+    from spark_rapids_tpu.parallel import scheduler as _sched
+    from spark_rapids_tpu.plan.logical import agg_sum, col
+
+    weights = "8,3,1"
+
+    def sess():
+        s = _session()
+        s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 2)
+        s.set("spark.rapids.sql.scheduler.qos.enabled", True)
+        s.set("spark.rapids.sql.scheduler.qos.weights", weights)
+        s.set("spark.rapids.sql.scheduler.qos.starvationBound", 2)
+        return s
+
+    def shape(s, i):
+        li = tpch._read(s, tpch_dir, "lineitem")
+        return li.filter(col("l_quantity") < float(5 + i % 8)) \
+            .agg(agg_sum(col("l_extendedprice")).alias("s"))
+
+    s = sess()
+    shape(s, 0).collect()                   # warm: template + kernels
+    c0 = _qos.counters()
+    lock = threading.Lock()
+    lat = {cls: [] for cls in _qos.CLASSES}
+    rejected = [0]
+    errors = [0]
+    classes = [("interactive", None), ("batch", None),
+               ("background", None), ("batch", "capped"),
+               ("batch", "capped")]
+    per_client = max(total // len(classes), 1)
+    capped = sess()
+    capped.set("spark.rapids.sql.scheduler.qos.tenantMaxInFlight", 1)
+
+    def client(k, cls, tenant):
+        cs = capped if tenant else s
+        for j in range(per_client):
+            i = k * per_client + j
+            q0 = time.perf_counter()
+            try:
+                shape(cs, i).collect(priority=cls,
+                                     tenant=tenant or f"t{k}")
+            except _sched.QueryRejectedError:
+                with lock:
+                    rejected[0] += 1
+                continue
+            except Exception:
+                with lock:
+                    errors[0] += 1
+                continue
+            took = time.perf_counter() - q0
+            with lock:
+                lat[cls].append(took)
+
+    t0 = time.perf_counter()
+    workers = [threading.Thread(target=client, args=(k, cls, tenant),
+                                daemon=True, name=f"srt-qos-{k}")
+               for k, (cls, tenant) in enumerate(classes)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    wall = time.perf_counter() - t0
+    c1 = _qos.counters()
+
+    def diff(name):
+        return int(c1.get(name, 0) - c0.get(name, 0))
+
+    def pct(xs, q):
+        if not xs:
+            return None
+        xs = sorted(xs)
+        return round(xs[min(int(q * len(xs)), len(xs) - 1)] * 1000, 2)
+
+    return {
+        "queries": per_client * len(classes), "clients": len(classes),
+        "max_concurrent": 2, "weights": weights,
+        "starvation_bound": 2,
+        "wall_s": round(wall, 3), "errors": errors[0],
+        "per_class": {
+            cls: {"count": len(lat[cls]), "p50_ms": pct(lat[cls], 0.50),
+                  "p99_ms": pct(lat[cls], 0.99)}
+            for cls in _qos.CLASSES
+        },
+        "rejections": {
+            kind: diff(f"rejected.{kind}")
+            for kind in ("queue-full", "admission-timeout",
+                         "tenant-quota", "deadline-unmeetable")
+        },
+        "rejected_total": rejected[0],
+        "starvation_bound_engagements": diff(
+            "starvationBoundEngagements"),
+        "quota_evictions": diff("quotaEvictions"),
     }
 
 
@@ -556,6 +677,10 @@ def main():
         # and the q6-class bind-only-vs-replan speedup).
         "plan_cache": {},
         "sustained": {},
+        # Serving QoS subsystem (parallel/qos/): per-class latency
+        # under weighted fair queueing, rejections by kind, starvation
+        # -bound engagements, and per-tenant quota evictions.
+        "qos": {},
         # Shuffle transport SPI (parallel/transport/): which transport
         # served the run plus its byte/shard counters — nonzero
         # remoteShardRefetches/remoteShardsLost say the run recovered
@@ -735,6 +860,19 @@ def main():
             sus = {"error": f"{type(e).__name__}: {e}"}
         with _LOCK:
             out["sustained"] = sus
+
+    # Serving QoS: mixed-class WFQ load with a capped tenant (the
+    # tenant-quota rejections and starvation-bound engagements the
+    # subsystem exists to produce under pressure).
+    if "q6" in _STATE["ok"] and _remaining(budget) > 45:
+        try:
+            qjs = _qos_probe(packs["q6"][1],
+                             int(os.environ.get("BENCH_QOS_QUERIES",
+                                                "100")))
+        except Exception as e:  # the headline must survive a probe bug
+            qjs = {"error": f"{type(e).__name__}: {e}"}
+        with _LOCK:
+            out["qos"] = qjs
 
     from spark_rapids_tpu.parallel import scheduler as _sched
     with _LOCK:

@@ -354,26 +354,40 @@ class DataFrame:
         traffic arrives (scripts/warmup.py drives this)."""
         return self._physical()
 
-    def collect(self, timeout_ms: Optional[float] = None) -> List[tuple]:
+    def collect(self, timeout_ms: Optional[float] = None,
+                priority: Optional[str] = None,
+                tenant: Optional[str] = None) -> List[tuple]:
         """Run the query through the multi-query scheduler
         (parallel/scheduler.py). ``timeout_ms`` arms a deadline: a query
         still running when it expires unwinds cooperatively at its next
         dispatch checkpoint with ``QueryCancelledError`` (reason
         "deadline exceeded"), releasing the TPU semaphore and every
         owned buffer. Raises ``QueryRejectedError`` when the scheduler's
-        run queue is full (load shed) or admission times out."""
-        return self._physical().collect(timeout_ms=timeout_ms)
+        run queue is full (load shed) or admission times out.
 
-    def submit(self, timeout_ms: Optional[float] = None):
+        With the QoS subsystem enabled (scheduler.qos.enabled),
+        ``priority`` picks the query's class ("interactive" / "batch" /
+        "background"), ``tenant`` tags it for per-tenant quotas, and
+        ``timeout_ms`` additionally acts as a deadline tested against
+        the cost estimate at admit time (kind "deadline-unmeetable").
+        Both default from conf (qos.priorityClass / qos.tenant)."""
+        return self._physical().collect(timeout_ms=timeout_ms,
+                                        priority=priority, tenant=tenant)
+
+    def submit(self, timeout_ms: Optional[float] = None,
+               priority: Optional[str] = None,
+               tenant: Optional[str] = None):
         """Async collect: returns a ``QueryHandle`` whose ``cancel()``
         stops the query cooperatively — while it is still queued for
         admission or mid-flight — and whose ``result()`` returns the
-        rows or re-raises the query's error."""
+        rows or re-raises the query's error. ``priority``/``tenant``
+        feed QoS scheduling exactly as in :meth:`collect`."""
         from spark_rapids_tpu.parallel.scheduler import QueryHandle
         phys = self._physical()
 
         def run(cancel_event, tmo):
-            return phys.collect(timeout_ms=tmo, cancel_event=cancel_event)
+            return phys.collect(timeout_ms=tmo, cancel_event=cancel_event,
+                                priority=priority, tenant=tenant)
 
         return QueryHandle(run, timeout_ms)
 

@@ -661,6 +661,76 @@ SCHEDULER_QUERY_MEMORY_FRACTION = conf(
     "budget); 1.0 = every query sees the full budget and isolation "
     "relies on admission + cross-query eviction.").double(1.0)
 
+QOS_ENABLED = conf("spark.rapids.sql.scheduler.qos.enabled").doc(
+    "Serving QoS subsystem (parallel/qos/): replaces the FIFO run queue "
+    "with weighted fair queueing across priority classes, "
+    "shortest-job-first ordering by the plan/cost.py estimate, "
+    "per-tenant quotas, and deadline-aware admission. Default off: the "
+    "scheduler is byte-for-byte the FIFO QueryManager. The SRT_QOS env "
+    "enables it for a whole process (the CI matrix hook); the conf key "
+    "wins when set.").boolean(False)
+
+QOS_PRIORITY_CLASS = conf(
+    "spark.rapids.sql.scheduler.qos.priorityClass").doc(
+    "This session's default priority class: 'interactive', 'batch', or "
+    "'background'. The priority= kwarg of DataFrame.collect/submit "
+    "overrides per call. Ignored (recorded only) when qos.enabled is "
+    "false.").string("batch")
+
+QOS_WEIGHTS = conf("spark.rapids.sql.scheduler.qos.weights").doc(
+    "WFQ weight vector 'interactive,batch,background' — run slots are "
+    "granted proportionally to these weights over any window (stride "
+    "scheduling; parallel/qos/policy.py). All weights must be > 0."
+).string("8,3,1")
+
+QOS_STARVATION_BOUND = conf(
+    "spark.rapids.sql.scheduler.qos.starvationBound").doc(
+    "Hard starvation bound: the max times a non-empty class may be "
+    "bypassed for a run slot before its head query runs NEXT regardless "
+    "of weights (counter starvationBoundEngagements).").integer(8)
+
+QOS_TENANT = conf("spark.rapids.sql.scheduler.qos.tenant").doc(
+    "Tenant identity for this session's queries (per-tenant quotas, "
+    "plan-cache stats, chaos isolation). The tenant= kwarg of "
+    "DataFrame.collect/submit overrides per call. Empty = 'default'."
+).string("")
+
+QOS_TENANT_MAX_IN_FLIGHT = conf(
+    "spark.rapids.sql.scheduler.qos.tenantMaxInFlight").doc(
+    "Per-tenant cap on in-flight (running + queued) queries; an "
+    "over-cap tenant is rejected at admission with a typed "
+    "QueryRejectedError (kind 'tenant-quota') carrying a retry-after "
+    "hint. 0 = unlimited.").integer(0)
+
+QOS_TENANT_MAX_CATALOG_BYTES = conf(
+    "spark.rapids.sql.scheduler.qos.tenantMaxCatalogBytes").doc(
+    "Per-tenant cap on owner-tagged catalog bytes "
+    "(BufferCatalog.owned_bytes summed over the tenant's active "
+    "queries) checked at admission. 0 = unlimited.").long(0)
+
+QOS_TENANT_MAX_KERNEL_ENTRIES = conf(
+    "spark.rapids.sql.scheduler.qos.tenantMaxKernelCacheEntries").doc(
+    "Per-tenant compile budget: kernel-cache entries owned by the "
+    "tenant's query ids (KernelCache.owners). Over the cap the "
+    "tenant's OLDEST entries are evicted at its next admission "
+    "(counter quotaEvictions) — never a rejection. 0 = unlimited."
+).integer(0)
+
+QOS_DEADLINE_ADMISSION = conf(
+    "spark.rapids.sql.scheduler.qos.deadlineAdmission.enabled").doc(
+    "Deadline-aware admission (qos.enabled only): a query whose "
+    "plan/cost.py estimate cannot meet its collect(timeout_ms=...) "
+    "deadline is rejected at admit time (kind 'deadline-unmeetable') "
+    "instead of burning device time and dying to the kill timer. "
+    "Un-priced queries always pass; the in-flight timer remains the "
+    "backstop.").boolean(True)
+
+QOS_DEADLINE_SLACK = conf(
+    "spark.rapids.sql.scheduler.qos.deadlineSlack").doc(
+    "Multiplier applied to the cost estimate before the deadline "
+    "admission test (>1.0 rejects earlier — estimates are optimistic "
+    "about queueing; <1.0 admits optimistically).").double(1.0)
+
 TEST_FAULTS_QUERY_TAG = conf(
     "spark.rapids.sql.test.faults.queryTag").doc(
     "Explicit fault tag for query-scoped chaos (kind@site/query=N "
@@ -1166,6 +1236,42 @@ def generate_docs() -> str:
         "neighbors. `SRT_SCHEDULER_MAX_CONCURRENT=1` degenerates to",
         "strictly serial queries, byte-identical to the pre-scheduler",
         "engine. See docs/robustness.md and tests/test_scheduler.py.",
+        "",
+        "## Serving QoS: priority classes, fair queueing, tenant quotas",
+        "",
+        "With `spark.rapids.sql.scheduler.qos.enabled` (default FALSE;",
+        "`SRT_QOS=1` enables for a whole process) the QueryManager's",
+        "FIFO run queue is replaced by the cost-aware QoS scheduler",
+        "(parallel/qos/): queries carry a priority class —",
+        "`interactive` / `batch` / `background`, from",
+        "`scheduler.qos.priorityClass` or the `priority=` kwarg of",
+        "`DataFrame.collect/submit` — and run slots are granted by",
+        "weighted fair queueing over `scheduler.qos.weights` with a",
+        "HARD starvation bound (`scheduler.qos.starvationBound`: after",
+        "that many bypasses a starved class's head runs next,",
+        "counter `starvationBoundEngagements`). Within a class,",
+        "queries drain shortest-job-first by the plan/cost.py estimate",
+        "(plan-cache hits reuse the template's CostReport, so ordering",
+        "is free for repeat shapes). Tenants",
+        "(`scheduler.qos.tenant` / the `tenant=` kwarg) get",
+        "admission-time quotas: in-flight query caps",
+        "(`tenantMaxInFlight`), owner-tagged catalog bytes",
+        "(`tenantMaxCatalogBytes`), and a kernel-cache compile budget",
+        "(`tenantMaxKernelCacheEntries`, enforced by evicting the",
+        "tenant's oldest entries — `quotaEvictions`). A deadline armed",
+        "via `collect(timeout_ms=...)` is additionally tested against",
+        "the cost estimate AT ADMISSION",
+        "(`qos.deadlineAdmission.enabled`): an unmeetable deadline",
+        "rejects immediately instead of burning device time. Every",
+        "rejection is a structured `QueryRejectedError` carrying",
+        "`kind` (`queue-full` / `admission-timeout` / `tenant-quota` /",
+        "`deadline-unmeetable`), a `queue_depth` snapshot, and a",
+        "`retry_after_ms` hint derived from observed service times.",
+        "Disabled, the scheduler is byte-for-byte the FIFO",
+        "QueryManager (the `qos-on` tier-1 matrix entry proves the",
+        "whole suite passes identically either way). See",
+        "docs/serving.md and tests/test_qos.py for the model and the",
+        "1000-query x 4-tenant soak contract.",
         "",
         "## Cost-based placement & adaptive re-planning",
         "",

@@ -167,14 +167,18 @@ class QueryToken:
     is enforced by the scheduler's timer arm (it sets the same event),
     so checkpoints only ever test one flag."""
 
-    __slots__ = ("query_id", "fault_tag", "cancel", "reason")
+    __slots__ = ("query_id", "fault_tag", "cancel", "reason", "tenant")
 
-    def __init__(self, query_id: int, fault_tag: Optional[int] = None):
+    def __init__(self, query_id: int, fault_tag: Optional[int] = None,
+                 tenant: Optional[str] = None):
         self.query_id = query_id
         # The tag query-scoped fault entries (kind@site/query=N) match.
         self.fault_tag = fault_tag if fault_tag is not None else query_id
         self.cancel = threading.Event()
         self.reason = "cancelled"
+        # Serving-tier identity (parallel/qos/): owner attribution for
+        # per-tenant quotas and plan-cache stats. None = untagged.
+        self.tenant = tenant
 
     def request_cancel(self, reason: str = "cancelled") -> None:
         self.reason = reason

@@ -232,6 +232,27 @@ class KernelCache:
         with self._lock:
             return dict(self._owners)
 
+    def evict_owned(self, owner_ids, keep: int) -> int:
+        """Per-tenant compile-budget enforcement (parallel/qos/): drop
+        the OLDEST entries whose owner tag is in ``owner_ids`` until at
+        most ``keep`` remain; returns how many were evicted. Evicted
+        kernels recompile transparently on next use — a quota, not a
+        correctness event."""
+        owner_ids = set(owner_ids)
+        with self._lock:
+            owned = [k for k in self._entries
+                     if self._owners.get(k) in owner_ids]
+            drop = len(owned) - max(int(keep), 0)
+            n = 0
+            for k in owned:
+                if n >= drop:
+                    break
+                self._entries.pop(k, None)
+                self._owners.pop(k, None)
+                self.evictions += 1
+                n += 1
+            return n
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             out = {"hits": self.hits, "misses": self.misses,

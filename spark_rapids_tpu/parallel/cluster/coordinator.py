@@ -249,6 +249,16 @@ class QueryRun:
         self._ctx = None
         self._trace_qid = 0
         self.finished = False
+        # QoS class rank (parallel/qos/): CPOLL offers ready stages of
+        # higher-priority queries first, BEFORE byte-score locality. The
+        # default class ("batch", rank 1) keeps the (rank, qid) sort
+        # identical to the plain qid sort when no query sets a class.
+        from spark_rapids_tpu.parallel import qos as Q
+        try:
+            cls = Q.resolve_class(str(conf.get(C.QOS_PRIORITY_CLASS)))
+        except ValueError:
+            cls = Q.DEFAULT_CLASS
+        self.qos_rank = Q.CLASS_RANK[cls]
 
     # -- driver side (planner hooks) -----------------------------------------
     def install(self, ctx) -> None:
@@ -591,7 +601,12 @@ class ClusterCoordinator:
                 stale = [q for q in known.split(",")
                          if q and q != "-"
                          and int(q) not in self.queries]
-                for qid in sorted(self.queries):
+                # Priority classes first (QoS rank, interactive < batch
+                # < background), stage-id/locality order within a query
+                # unchanged; qid tiebreak keeps the scan deterministic.
+                for qid in sorted(self.queries,
+                                  key=lambda q:
+                                  (self.queries[q].qos_rank, q)):
                     picked = self.queries[qid]._pick_locked(wid)
                     if picked is not None:
                         line, _ = picked

@@ -1,0 +1,97 @@
+"""Cost-aware admission policy (ISSUE 14 tentpole parts c/d).
+
+:class:`QosPolicy` is the object a QoS-enabled QueryManager carries in
+place of its FIFO waiter list: the WFQ run queue (policy.py), the
+per-tenant quota tracker (quotas.py), and the admission-time checks —
+tenant caps and the deadline feasibility test — that run BEFORE a query
+ever takes a queue slot. All methods are called under the manager's
+lock unless noted.
+
+Deadline-aware admission: ``collect(timeout_ms=...)`` already arms a
+kill timer; with QoS on the SAME deadline is tested against the
+plan/cost.py estimate at admit time — a query whose estimated
+device+host time (scaled by ``qos.deadlineSlack``) cannot fit its
+deadline is rejected IMMEDIATELY (kind ``deadline-unmeetable``) instead
+of burning a run slot and device time only to be deadline-killed
+mid-flight. Un-priced queries (cost model off/skipped) always pass —
+the in-flight kill timer remains the backstop.
+
+Retry-after hints: every load-type rejection carries an estimate of
+when resubmitting could succeed, derived from the manager's EWMA of
+observed query service times. Deadline rejections carry
+``retry_after_ms=None`` — retrying the same query with the same
+deadline can never help.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from spark_rapids_tpu.parallel.qos.policy import WfqQueue, parse_weights
+from spark_rapids_tpu.parallel.qos.quotas import TenantQuotas
+
+
+class QosPolicy:
+    """Everything a QueryManager needs beyond FIFO, in one handle."""
+
+    def __init__(self, weights_spec: str, starvation_bound: int):
+        self.weights_spec = str(weights_spec)
+        self.queue = WfqQueue(parse_weights(weights_spec), starvation_bound)
+        self.quotas = TenantQuotas()
+
+    @property
+    def sig(self):
+        """Structural identity for the idle-only manager resize check."""
+        return (self.weights_spec, self.queue.starvation_bound)
+
+    # -- admission checks (caller holds the manager lock) --------------------
+    def deadline_rejects(self, conf, cost_ms: Optional[float],
+                         deadline_ms: Optional[float]) -> Optional[str]:
+        """The rejection reason when the cost estimate cannot meet the
+        deadline, else None (admit)."""
+        from spark_rapids_tpu import config as C
+        if deadline_ms is None or deadline_ms <= 0 or cost_ms is None:
+            return None
+        if not bool(conf.get(C.QOS_DEADLINE_ADMISSION)):
+            return None
+        slack = max(float(conf.get(C.QOS_DEADLINE_SLACK)), 0.0)
+        est = cost_ms * slack
+        if est > deadline_ms:
+            return (f"deadline {deadline_ms:.0f}ms unmeetable: cost "
+                    f"estimate {est:.0f}ms (qos.deadlineSlack applied)")
+        return None
+
+    def tenant_rejects(self, conf, tenant: str,
+                       active_tickets) -> Optional[str]:
+        """The rejection reason when the tenant is over an admission
+        cap (in-flight queries or catalog bytes), else None."""
+        from spark_rapids_tpu import config as C
+        cap = int(conf.get(C.QOS_TENANT_MAX_IN_FLIGHT))
+        if cap > 0 and self.quotas.inflight(tenant) >= cap:
+            return (f"tenant {tenant!r} at in-flight cap "
+                    f"({self.quotas.inflight(tenant)}/{cap})")
+        bcap = int(conf.get(C.QOS_TENANT_MAX_CATALOG_BYTES))
+        if bcap > 0:
+            mine = [t for t in active_tickets
+                    if getattr(t, "tenant", None) == tenant]
+            used = self.quotas.catalog_bytes(mine)
+            if used >= bcap:
+                return (f"tenant {tenant!r} at catalog-bytes cap "
+                        f"({used}/{bcap} owner-tagged bytes)")
+        return None
+
+    def enforce_kernel_quota(self, conf, tenant: str) -> int:
+        """Kernel-cache compile quota: evict the tenant's OLDEST cache
+        entries down to the cap (never rejects — a compile budget is a
+        cache budget). Returns evicted count. Takes the cache's own
+        lock; call OUTSIDE hot paths only (admission)."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu.ops import kernel_cache as KC
+        cap = int(conf.get(C.QOS_TENANT_MAX_KERNEL_ENTRIES))
+        if cap <= 0:
+            return 0
+        cache = KC.cache()
+        have = self.quotas.kernel_entries(tenant, cache.owners())
+        if have <= cap:
+            return 0
+        return cache.evict_owned(self.quotas.query_ids(tenant), keep=cap)

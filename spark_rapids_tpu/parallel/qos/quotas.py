@@ -1,0 +1,105 @@
+"""Per-tenant quotas (ISSUE 14 tentpole part c).
+
+A *tenant* is a serving-tier identity — one user, one client pool, one
+product — named by ``spark.rapids.sql.scheduler.qos.tenant`` (or the
+``tenant=`` kwarg of ``DataFrame.collect/submit``). The tracker holds
+three admission-time caps, all default-unlimited (0):
+
+- **In-flight queries** (``tenantMaxInFlight``): running + queued
+  queries of the tenant; checked before the query ever enters the run
+  queue, so one tenant cannot monopolize the queue depth either.
+- **Catalog bytes** (``tenantMaxCatalogBytes``): the sum of the
+  tenant's active queries' owner-tagged catalog registrations
+  (:meth:`BufferCatalog.owned_bytes` — the per-query accounting view
+  the scheduler's isolation tests already assert on). A tenant sitting
+  on that many spillable bytes is rejected until its queries retire.
+- **Kernel-cache entries** (``tenantMaxKernelCacheEntries``): compiled
+  kernels whose owner tag (:meth:`KernelCache.owners`) maps to one of
+  the tenant's query ids. Over the cap the tenant's OLDEST entries are
+  EVICTED (counter ``quotaEvictions``) rather than the query rejected —
+  compilation quota is a cache budget, not an admission failure.
+
+Ownership attribution: the kernel cache tags entries with the query id
+that paid the compile, so the tracker keeps a persistent
+``query id -> tenant`` map (entries outlive the query that compiled
+them). Bounded: ids whose entries left the cache are pruned on sweep.
+
+Pure bookkeeping: the QueryManager's lock covers every mutation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+DEFAULT_TENANT = "default"
+
+
+def resolve_tenant(name: Optional[str]) -> str:
+    v = str(name).strip() if name else ""
+    return v or DEFAULT_TENANT
+
+
+class TenantQuotas:
+    """In-flight reservations + owner attribution for one QueryManager."""
+
+    def __init__(self):
+        self._inflight: Dict[str, int] = {}
+        self._qid_tenant: Dict[int, str] = {}
+
+    # -- in-flight reservations ----------------------------------------------
+    def inflight(self, tenant: str) -> int:
+        return self._inflight.get(tenant, 0)
+
+    def reserve(self, tenant: str) -> None:
+        self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+
+    def release(self, tenant: str) -> None:
+        n = self._inflight.get(tenant, 0) - 1
+        if n <= 0:
+            self._inflight.pop(tenant, None)
+        else:
+            self._inflight[tenant] = n
+
+    # -- ownership attribution -----------------------------------------------
+    def record_query(self, query_id: int, tenant: str) -> None:
+        """Remember which tenant an issued query id belongs to; kernel
+        cache entries it compiles stay attributable after it retires."""
+        self._qid_tenant[query_id] = tenant
+
+    def tenant_of(self, query_id: Optional[int]) -> Optional[str]:
+        if query_id is None:
+            return None
+        return self._qid_tenant.get(query_id)
+
+    def query_ids(self, tenant: str) -> set:
+        return {qid for qid, t in self._qid_tenant.items() if t == tenant}
+
+    def prune(self, live_query_ids: Iterable) -> None:
+        """Drop attribution for ids with no remaining kernel-cache
+        entries and no active ticket (bounds the map)."""
+        keep = set(live_query_ids)
+        for qid in [q for q in self._qid_tenant if q not in keep]:
+            self._qid_tenant.pop(qid, None)
+
+    # -- catalog bytes -------------------------------------------------------
+    @staticmethod
+    def catalog_bytes(tickets) -> int:
+        """Owner-tagged registered bytes across the given tickets'
+        contexts (each admitted query owns its own catalog; the owner
+        tag is its query id)."""
+        total = 0
+        for t in tickets:
+            ctx = getattr(t, "ctx", None)
+            catalog = getattr(ctx, "_catalog", None)
+            if catalog is None:
+                continue
+            owned = catalog.owned_bytes()
+            total += owned.get(t.query_id, 0)
+        return total
+
+    # -- kernel-cache entries ------------------------------------------------
+    def kernel_entries(self, tenant: str, owners: Dict) -> int:
+        """How many kernel-cache entries the tenant's query ids own.
+        ``owners`` is :meth:`KernelCache.owners` (key -> query id)."""
+        qids = self.query_ids(tenant)
+        return sum(1 for qid in owners.values() if qid in qids)

@@ -98,35 +98,64 @@ def record_plan_cache(ctx, hit: bool) -> None:
     ``scheduler`` block reports: ``planCacheBindOnly`` executions
     skipped planning entirely (plan once, bind literals, dispatch);
     ``planCacheMiss`` executions paid a template plan this tenant's
-    later calls amortize."""
+    later calls amortize. Tenant-tagged queries (the ``tenant=`` kwarg
+    or ``scheduler.qos.tenant``) additionally land in the per-tenant
+    QoS counters bench.py's ``qos``/``sustained`` blocks report."""
     name = "planCacheBindOnly" if hit else "planCacheMiss"
     metrics_entry(ctx).add(name, 1)
     _record(name)
+    tenant = getattr(getattr(ctx, "query", None), "tenant", None)
+    if tenant:
+        from spark_rapids_tpu.parallel import qos as Q
+        Q._record(f"planCache{'Hit' if hit else 'Miss'}.{tenant}")
 
 
 class QueryRejectedError(RuntimeError):
-    """Load shed: the run queue was full, or the admission wait timed
-    out. Deliberately NOT a transient error (no retry marker): the
-    caller — a serving tier, a test — decides whether to resubmit."""
+    """Load shed or policy rejection. Deliberately NOT a transient
+    error (no retry marker): the caller — a serving tier, a test —
+    decides whether to resubmit, guided by the structured fields:
 
-    def __init__(self, reason: str):
+    - ``kind``: ``queue-full`` | ``admission-timeout`` |
+      ``tenant-quota`` | ``deadline-unmeetable``
+    - ``queue_depth``: run-queue occupancy snapshot at rejection
+    - ``retry_after_ms``: when resubmitting could plausibly succeed
+      (observed-service-time estimate); None when retrying as-is can
+      never help (an unmeetable deadline)."""
+
+    def __init__(self, reason: str, kind: str = "rejected",
+                 queue_depth: Optional[int] = None,
+                 retry_after_ms: Optional[float] = None):
         super().__init__(
             f"REJECTED: {reason} (spark.rapids.sql.scheduler.*)")
         self.reason = reason
+        self.kind = kind
+        self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
 
 
 class QueryTicket:
     """One admitted query: its token (cancellation handle + owner id),
     admission bookkeeping, and the context registration cross-query
-    eviction walks."""
+    eviction walks. QoS admissions (parallel/qos/) additionally carry
+    the priority class, tenant, and the cost estimate that ordered the
+    queue; FIFO admissions leave them None (tenant may still be set —
+    it is pure attribution, never a scheduling input there)."""
 
-    __slots__ = ("token", "queued_ms", "ctx", "deadline_timer")
+    __slots__ = ("token", "queued_ms", "ctx", "deadline_timer",
+                 "qos_class", "tenant", "cost_ms", "admitted_at")
 
-    def __init__(self, token: faults.QueryToken, queued_ms: float):
+    def __init__(self, token: faults.QueryToken, queued_ms: float,
+                 qos_class: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 cost_ms: Optional[float] = None):
         self.token = token
         self.queued_ms = queued_ms
         self.ctx = None                 # registered by PhysicalPlan.collect
         self.deadline_timer: Optional[threading.Timer] = None
+        self.qos_class = qos_class
+        self.tenant = tenant
+        self.cost_ms = cost_ms
+        self.admitted_at = time.perf_counter()
 
     @property
     def query_id(self) -> int:
@@ -156,7 +185,7 @@ class QueryManager:
     reconfigure without racing in-flight queries."""
 
     def __init__(self, max_concurrent: int = 2, queue_depth: int = 16,
-                 admission_timeout_ms: int = 60000):
+                 admission_timeout_ms: int = 60000, qos=None):
         self.max_concurrent = max(int(max_concurrent), 1)
         self.queue_depth = max(int(queue_depth), 0)
         self.admission_timeout_ms = max(int(admission_timeout_ms), 1)
@@ -165,36 +194,63 @@ class QueryManager:
         self._waiters: List[threading.Event] = []   # FIFO run queue
         self._active: Dict[int, QueryTicket] = {}
         self._next_id = 0
+        # Serving QoS (parallel/qos/, default None = the FIFO queue
+        # above, byte-for-byte the pre-QoS scheduler): a QosPolicy
+        # carrying the WFQ run queue + tenant quota tracker.
+        self._qos = qos
+        # Observed query service time EWMA (both modes; feeds the
+        # retry_after_ms hint on rejections — attribution only, never
+        # a scheduling input on the FIFO path).
+        self._service_ewma_ms: Optional[float] = None
 
     # -- admission -----------------------------------------------------------
     def admit(self, conf=None,
-              cancel: Optional[threading.Event] = None) -> QueryTicket:
-        """Block until a run slot frees (FIFO), up to the admission
-        timeout; raise :class:`QueryRejectedError` immediately when the
-        queue is full (load shed) or on timeout. ``cancel`` (the
-        eventual query's cancel event, when the caller pre-creates it
-        for a handle) aborts the wait too — a queued query is
-        cancellable before it ever runs."""
+              cancel: Optional[threading.Event] = None,
+              priority: Optional[str] = None,
+              tenant: Optional[str] = None,
+              cost_ms: Optional[float] = None,
+              deadline_ms: Optional[float] = None) -> QueryTicket:
+        """Block until a run slot frees (FIFO, or WFQ order when the
+        QoS subsystem is enabled), up to the admission timeout; raise
+        :class:`QueryRejectedError` immediately when the queue is full
+        (load shed), a QoS policy check fails (tenant quota, unmeetable
+        deadline), or on timeout. ``cancel`` (the eventual query's
+        cancel event, when the caller pre-creates it for a handle)
+        aborts the wait too — a queued query is cancellable before it
+        ever runs. ``priority``/``tenant``/``cost_ms``/``deadline_ms``
+        feed the QoS policy; on the FIFO path only ``tenant`` is kept
+        (as pure attribution for per-tenant stats)."""
+        if self._qos is not None:
+            return self._admit_qos(conf, cancel, priority, tenant,
+                                   cost_ms, deadline_ms)
         from spark_rapids_tpu import config as C
         tag = None
+        tnt = tenant
         if conf is not None:
             t = int(conf.get(C.TEST_FAULTS_QUERY_TAG))
             if t >= 0:
                 tag = t
+            if tnt is None:
+                v = str(conf.get(C.QOS_TENANT) or "").strip()
+                tnt = v or None
         me: Optional[threading.Event] = None
         t0 = time.perf_counter()
         with self._lock:
             if self._slots_free > 0 and not self._waiters:
                 self._slots_free -= 1
-                return self._issue(tag, 0.0, cancel)
+                return self._issue(tag, 0.0, cancel, tenant=tnt)
             if len(self._waiters) >= self.queue_depth:
                 _record("rejected")
+                depth = len(self._waiters)
+                hint = self._retry_hint_locked()
                 from spark_rapids_tpu import monitoring
                 monitoring.instant("query-rejected", "recovery",
                                    args={"reason": "queue full"})
                 raise QueryRejectedError(
-                    f"run queue full ({len(self._waiters)} queued, "
-                    f"{self.max_concurrent} running)")
+                    f"run queue full ({depth} queued, "
+                    f"{self.max_concurrent} running)",
+                    kind="queue-full", queue_depth=depth,
+                    retry_after_ms=hint)
             me = threading.Event()
             self._waiters.append(me)
         deadline = t0 + self.admission_timeout_ms / 1000.0
@@ -208,6 +264,8 @@ class QueryManager:
                         # Granted between the timeout and the lock: the
                         # slot is ours to give back.
                         self._release_slot_locked()
+                    depth = len(self._waiters)
+                    hint = self._retry_hint_locked()
                 from spark_rapids_tpu import monitoring
                 if cancel is not None and cancel.is_set():
                     _record("cancelled")
@@ -222,49 +280,203 @@ class QueryManager:
                 raise QueryRejectedError(
                     f"admission timeout after "
                     f"{self.admission_timeout_ms}ms "
-                    f"({self.max_concurrent} running)")
+                    f"({self.max_concurrent} running)",
+                    kind="admission-timeout", queue_depth=depth,
+                    retry_after_ms=hint)
             if me.wait(min(remaining, 0.05)):
                 with self._lock:
                     queued_ms = (time.perf_counter() - t0) * 1000.0
-                    return self._issue(tag, queued_ms, cancel)
+                    return self._issue(tag, queued_ms, cancel, tenant=tnt)
+
+    def _admit_qos(self, conf, cancel, priority, tenant, cost_ms,
+                   deadline_ms) -> QueryTicket:
+        """QoS admission (parallel/qos/): tenant quotas + deadline
+        feasibility first, then the WFQ run queue instead of FIFO."""
+        from spark_rapids_tpu import config as C
+        from spark_rapids_tpu import monitoring
+        from spark_rapids_tpu.parallel import qos as Q
+        qos = self._qos
+        qcls = Q.resolve_class(
+            priority if priority is not None else
+            (str(conf.get(C.QOS_PRIORITY_CLASS)) if conf is not None
+             else None))
+        tnt = Q.resolve_tenant(
+            tenant if tenant is not None else
+            (str(conf.get(C.QOS_TENANT) or "") if conf is not None
+             else None))
+        tag = None
+        if conf is not None:
+            t = int(conf.get(C.TEST_FAULTS_QUERY_TAG))
+            if t >= 0:
+                tag = t
+            # Kernel-cache compile budget: enforced by evicting the
+            # tenant's oldest entries, never by rejecting (the cache
+            # has its own leaf lock — taken outside the manager's).
+            evicted = qos.enforce_kernel_quota(conf, tnt)
+            if evicted:
+                Q._record("quotaEvictions", evicted)
+                monitoring.instant(
+                    "qos-quota-eviction", "recovery",
+                    args={"tenant": tnt, "entriesEvicted": evicted})
+
+        def reject(kind, reason, depth, hint):
+            _record("rejected")
+            Q._record(f"rejected.{kind}")
+            monitoring.instant(
+                "query-rejected", "recovery",
+                args={"reason": reason, "kind": kind, "tenant": tnt,
+                      "class": qcls})
+            raise QueryRejectedError(reason, kind=kind, queue_depth=depth,
+                                     retry_after_ms=hint)
+
+        me: Optional[threading.Event] = None
+        entry = None
+        t0 = time.perf_counter()
+        with self._lock:
+            if conf is not None:
+                reason = qos.deadline_rejects(conf, cost_ms, deadline_ms)
+                if reason is not None:
+                    # Retrying the same query with the same deadline
+                    # can never help: no retry-after hint.
+                    reject("deadline-unmeetable", reason,
+                           len(qos.queue), None)
+                reason = qos.tenant_rejects(
+                    conf, tnt, list(self._active.values()))
+                if reason is not None:
+                    reject("tenant-quota", reason, len(qos.queue),
+                           self._retry_hint_locked())
+            if self._slots_free > 0 and len(qos.queue) == 0:
+                self._slots_free -= 1
+                qos.quotas.reserve(tnt)
+                return self._issue(tag, 0.0, cancel, qos_class=qcls,
+                                   tenant=tnt, cost_ms=cost_ms)
+            if len(qos.queue) >= self.queue_depth:
+                reject("queue-full",
+                       f"run queue full ({len(qos.queue)} queued, "
+                       f"{self.max_concurrent} running)",
+                       len(qos.queue), self._retry_hint_locked())
+            me = threading.Event()
+            entry = qos.queue.push(qcls, cost_ms, me, tnt)
+            qos.quotas.reserve(tnt)
+        deadline = t0 + self.admission_timeout_ms / 1000.0
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0 or (cancel is not None and cancel.is_set()):
+                with self._lock:
+                    if not entry.granted:
+                        qos.queue.discard(entry)
+                    elif me.is_set():
+                        # Granted between the timeout and the lock: the
+                        # slot is ours to give back.
+                        self._release_slot_locked()
+                    qos.quotas.release(tnt)
+                    depth = len(qos.queue)
+                    hint = self._retry_hint_locked()
+                if cancel is not None and cancel.is_set():
+                    _record("cancelled")
+                    monitoring.instant(
+                        "query-cancelled", "recovery",
+                        args={"reason": "cancelled while queued"})
+                    raise faults.QueryCancelledError(
+                        -1, "cancelled while queued")
+                reject("admission-timeout",
+                       f"admission timeout after "
+                       f"{self.admission_timeout_ms}ms "
+                       f"({self.max_concurrent} running)",
+                       depth, hint)
+            if me.wait(min(remaining, 0.05)):
+                with self._lock:
+                    queued_ms = (time.perf_counter() - t0) * 1000.0
+                    return self._issue(tag, queued_ms, cancel,
+                                       qos_class=qcls, tenant=tnt,
+                                       cost_ms=cost_ms)
 
     def _issue(self, tag: Optional[int], queued_ms: float,
-               cancel: Optional[threading.Event]) -> QueryTicket:
+               cancel: Optional[threading.Event],
+               qos_class: Optional[str] = None,
+               tenant: Optional[str] = None,
+               cost_ms: Optional[float] = None) -> QueryTicket:
         """Build the admitted ticket (caller holds the lock / the slot)."""
         self._next_id += 1
-        token = faults.QueryToken(self._next_id, tag)
+        token = faults.QueryToken(self._next_id, tag, tenant=tenant)
         if cancel is not None:
             # The handle pre-created the cancel event (so cancel() works
             # while still queued); the token adopts it.
             token.cancel = cancel
-        ticket = QueryTicket(token, queued_ms)
+        ticket = QueryTicket(token, queued_ms, qos_class=qos_class,
+                             tenant=tenant, cost_ms=cost_ms)
         self._active[token.query_id] = ticket
         _record("admitted")
         _record("queuedMs", queued_ms)
+        if qos_class is not None:
+            from spark_rapids_tpu.parallel import qos as Q
+            Q._record(f"admitted.{qos_class}")
+            self._qos.quotas.record_query(token.query_id, tenant)
         # Retro-record the admission wait as a "queued" span on the
         # query's OWN track: the id the wait was for only exists now.
         from spark_rapids_tpu import monitoring
         if monitoring.enabled():
             dur = int(queued_ms * 1e6)
+            args = {"queuedMs": round(queued_ms, 2)}
+            if qos_class is not None:
+                args["class"] = qos_class
+                args["tenant"] = tenant
             monitoring.record_span(
                 "admission-queue", "queued", monitoring.now_ns() - dur,
-                dur, qid=token.query_id,
-                args={"queuedMs": round(queued_ms, 2)},
+                dur, qid=token.query_id, args=args,
                 level=monitoring.LEVEL_QUERY)
         return ticket
 
     def _release_slot_locked(self) -> None:
+        if self._qos is not None:
+            entry, starved = self._qos.queue.pop_next()
+            if entry is not None:
+                if starved:
+                    from spark_rapids_tpu import monitoring
+                    from spark_rapids_tpu.parallel import qos as Q
+                    Q._record("starvationBoundEngagements")
+                    monitoring.instant(
+                        "qos-starvation-bound", "recovery",
+                        args={"class": entry.qos_class})
+                entry.event.set()       # hand the slot over, WFQ order
+            else:
+                self._slots_free += 1
+            return
         if self._waiters:
             self._waiters.pop(0).set()      # hand the slot over, FIFO
         else:
             self._slots_free += 1
+
+    def _observe_service_locked(self, service_ms: float) -> None:
+        if service_ms < 0:
+            return
+        if self._service_ewma_ms is None:
+            self._service_ewma_ms = service_ms
+        else:
+            self._service_ewma_ms += 0.2 * (
+                service_ms - self._service_ewma_ms)
+
+    def _retry_hint_locked(self) -> float:
+        """The retry_after_ms hint: the queue ahead of a resubmission
+        drained at the observed service rate (250ms prior before any
+        query has finished)."""
+        base = self._service_ewma_ms \
+            if self._service_ewma_ms is not None else 250.0
+        queued = len(self._qos.queue) if self._qos is not None \
+            else len(self._waiters)
+        waves = (1 + queued) / max(self.max_concurrent, 1)
+        return round(max(50.0, base * waves), 1)
 
     def finish(self, ticket: QueryTicket) -> None:
         """Query teardown (success, failure, or cancel): release the run
         slot, wake the next queued query, disarm the deadline."""
         if ticket.deadline_timer is not None:
             ticket.deadline_timer.cancel()
+        service_ms = (time.perf_counter() - ticket.admitted_at) * 1000.0
         with self._lock:
+            self._observe_service_locked(service_ms)
+            if self._qos is not None and ticket.tenant is not None:
+                self._qos.quotas.release(ticket.tenant)
             self._active.pop(ticket.query_id, None)
             self._release_slot_locked()
 
@@ -309,7 +521,15 @@ class QueryManager:
     @property
     def queued_count(self) -> int:
         with self._lock:
+            if self._qos is not None:
+                return len(self._qos.queue)
             return len(self._waiters)
+
+    @property
+    def qos(self):
+        """The QosPolicy when the QoS subsystem is enabled, else None
+        (FIFO mode)."""
+        return self._qos
 
 
 _MANAGER: Optional[QueryManager] = None
@@ -321,11 +541,28 @@ def _env_max_concurrent() -> Optional[int]:
     return int(v) if v else None
 
 
+def _qos_sig(conf) -> Optional[tuple]:
+    """The (weights, starvationBound) structural signature when the QoS
+    subsystem is enabled for this conf/env, else None (FIFO)."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.parallel import qos as Q
+    if not Q.qos_enabled(conf):
+        return None
+    if conf is not None:
+        return (str(conf.get(C.QOS_WEIGHTS)),
+                max(int(conf.get(C.QOS_STARVATION_BOUND)), 1))
+    return (str(C.QOS_WEIGHTS.default),
+            max(int(C.QOS_STARVATION_BOUND.default), 1))
+
+
 def get_query_manager(conf=None) -> QueryManager:
     """The process-wide manager. Sized from the first conf seen (like
     the TPU semaphore) with the SRT_SCHEDULER_MAX_CONCURRENT env
     override; re-sized from a later conf only while completely idle —
-    in-flight queries never see the bound change under them."""
+    in-flight queries never see the bound change under them. The QoS
+    gate (scheduler.qos.enabled / SRT_QOS) and its structural knobs
+    (weights, starvation bound) participate in the same idle-only
+    resize, so flipping the subsystem mid-flight is impossible."""
     from spark_rapids_tpu import config as C
     global _MANAGER
     want = None
@@ -336,19 +573,30 @@ def get_query_manager(conf=None) -> QueryManager:
         env = _env_max_concurrent()
         if env is not None:
             want = (max(env, 1),) + want[1:]
+
+    def build(sizes) -> QueryManager:
+        from spark_rapids_tpu.parallel import qos as Q
+        sig = _qos_sig(conf)
+        policy = Q.QosPolicy(*sig) if sig is not None else None
+        return QueryManager(*sizes, qos=policy)
+
     with _MANAGER_LOCK:
         if _MANAGER is None:
             if want is None:
                 env = _env_max_concurrent()
                 want = (max(env, 1) if env else 2, 16, 60000)
-            _MANAGER = QueryManager(*want)
+            _MANAGER = build(want)
         elif want is not None and (
-                _MANAGER.max_concurrent, _MANAGER.queue_depth,
-                _MANAGER.admission_timeout_ms) != want:
+                (_MANAGER.max_concurrent, _MANAGER.queue_depth,
+                 _MANAGER.admission_timeout_ms) != want
+                or (_MANAGER._qos.sig if _MANAGER._qos is not None
+                    else None) != _qos_sig(conf)):
             with _MANAGER._lock:
-                idle = not _MANAGER._active and not _MANAGER._waiters
+                idle = not _MANAGER._active and not _MANAGER._waiters \
+                    and (_MANAGER._qos is None
+                         or len(_MANAGER._qos.queue) == 0)
             if idle:
-                _MANAGER = QueryManager(*want)
+                _MANAGER = build(want)
         return _MANAGER
 
 

@@ -435,13 +435,15 @@ class BoundPlan:
         ctx.cache["plan_binds"] = self.bind_values
         ctx.cache["plan_bind_dtypes"] = self.bind_dtypes
 
-    def collect(self, ctx=None, timeout_ms=None, cancel_event=None):
+    def collect(self, ctx=None, timeout_ms=None, cancel_event=None,
+                priority=None, tenant=None):
         if self.cache_hit:
             _record("bindOnlyExecutions")
         return self.template.collect(
             ctx, timeout_ms=timeout_ms, cancel_event=cancel_event,
             bindings=(self.bind_values, self.bind_dtypes),
-            plan_cache_hit=self.cache_hit)
+            plan_cache_hit=self.cache_hit, priority=priority,
+            tenant=tenant)
 
     def explain(self, mode: str = "ALL") -> str:
         report = self.template.explain(mode)

@@ -437,13 +437,21 @@ class PhysicalPlan:
         return "\n".join(lines)
 
     def collect(self, ctx=None, timeout_ms=None, cancel_event=None,
-                bindings=None, plan_cache_hit=None):
+                bindings=None, plan_cache_hit=None, priority=None,
+                tenant=None):
         """``bindings`` is the plan cache's ``(values, dtypes)`` pair for
         a parameterized template: installed into every execution
         context (including fresh-context retries) so bind slots, limit
         budgets and scan predicates resolve to THIS call's literals.
         ``plan_cache_hit`` (when not None) records the per-tenant
-        plan-cache outcome on the Scheduler@query entry."""
+        plan-cache outcome on the Scheduler@query entry.
+
+        ``priority``/``tenant`` feed the QoS subsystem (parallel/qos/):
+        the priority class routes the query through the weighted-fair
+        queue, the tenant tag enforces per-tenant quotas, and
+        ``timeout_ms`` doubles as the deadline the cost estimate is
+        tested against at admit time. With QoS off, both collapse to
+        pure attribution on the ticket."""
         import time as _time
 
         from spark_rapids_tpu import faults, monitoring
@@ -466,7 +474,17 @@ class PhysicalPlan:
         mgr = None
         if owned and faults.get_query_token() is None:
             mgr = SC.get_query_manager(self.conf)
-            ticket = mgr.admit(self.conf, cancel=cancel_event)
+            # The admission cost estimate: the plan's device+host
+            # wall-clock projection (plan/cost.py). Plan-cache hits
+            # reuse the template's CostReport, so repeat shapes carry
+            # their SJF ordering key for free.
+            est = getattr(self, "cost_report", None)
+            est_ms = None
+            if est is not None and est.skipped is None:
+                est_ms = float(est.est_device_ms) + float(est.est_host_ms)
+            ticket = mgr.admit(self.conf, cancel=cancel_event,
+                               priority=priority, tenant=tenant,
+                               cost_ms=est_ms, deadline_ms=timeout_ms)
             ticket.arm_deadline(timeout_ms)
             faults.set_query_token(ticket.token)
         ctx = ctx or ExecContext(self.conf, query=ticket)
@@ -490,6 +508,10 @@ class PhysicalPlan:
             sched = SC.metrics_entry(ctx)
             sched.add("admitted", 1)
             sched.add("queuedMs", ticket.queued_ms)
+            if ticket.qos_class is not None:
+                sched.add(f"class.{ticket.qos_class}", 1)
+            if ticket.tenant is not None:
+                sched.add(f"tenant.{ticket.tenant}", 1)
             if plan_cache_hit is not None:
                 # Per-tenant plan-cache stats (plan/plan_cache.py): a
                 # hit means this execution was bind-only — zero

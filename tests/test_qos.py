@@ -1,0 +1,727 @@
+"""Serving QoS subsystem (ISSUE 14; parallel/qos/).
+
+The contracts under test:
+
+- WFQ drain order is a pure function of the arrival schedule: a seeded
+  schedule drains in the same order every run, weighted service tracks
+  the weight vector, and SJF orders within a class (un-priced queries
+  after every priced one, FIFO among themselves).
+- The HARD starvation bound engages: with a lopsided weight vector a
+  starved class's head still runs after at most ``starvationBound``
+  bypasses, and the engagement is counted.
+- Deadline-aware admission rejects at ADMIT time when the cost estimate
+  cannot meet ``timeout_ms`` (kind ``deadline-unmeetable``, no
+  retry-after hint), while un-priced queries pass admission and the
+  in-flight deadline timer remains the backstop.
+- Per-tenant quotas: in-flight caps and catalog-byte caps reject with
+  kind ``tenant-quota`` (+ retry-after hint); the kernel-cache compile
+  budget EVICTS the tenant's oldest entries instead of rejecting.
+- QueryRejectedError carries structured fields (kind / queue_depth /
+  retry_after_ms) on every rejection path, FIFO and QoS alike.
+- scheduler.qos.enabled=false leaves the FIFO scheduler untouched:
+  priority/tenant kwargs are pure attribution, grant order is arrival
+  order.
+- The 1000-query soak (200 in CI; SRT_SOAK=1 runs the full bound): 4
+  tenants x mixed classes x parameterized queries at
+  maxConcurrentQueries=4 — bit-identical results, p99 bounded vs
+  serial, empty leak reports, per-tenant chaos invisible to the other
+  tenants, background still progressing.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.faults import QueryCancelledError
+from spark_rapids_tpu.memory import oom
+from spark_rapids_tpu.parallel import qos as Q
+from spark_rapids_tpu.parallel import scheduler as SC
+from spark_rapids_tpu.parallel.qos import QosPolicy, TenantQuotas, WfqQueue
+from spark_rapids_tpu.parallel.qos.policy import parse_weights
+from spark_rapids_tpu.parallel.scheduler import (
+    QueryManager, QueryRejectedError)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    Q.reset_counters()
+    oom.reset_degradation()
+    yield
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    Q.reset_counters()
+    oom.reset_degradation()
+    # A test may have rebuilt the process-wide manager in QoS mode;
+    # drop it so later modules start from the default FIFO scheduler.
+    with SC._MANAGER_LOCK:
+        SC._MANAGER = None
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_qos"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=11)
+    return d
+
+
+def _qos_session(tag=None, chaos="", max_concurrent=4, **extra):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.scheduler.maxConcurrentQueries",
+          max_concurrent)
+    s.set("spark.rapids.sql.scheduler.qos.enabled", True)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    # Chaos sessions only: even an EMPTY armed fault key makes the cost
+    # model stand down (plan/cost.py skips under chaos), which would
+    # silently turn every deadline-admission test into an un-priced
+    # pass-through. clean_state disarms the registry around each test.
+    if chaos:
+        s.set("spark.rapids.sql.test.faults", chaos)
+        s.set("spark.rapids.sql.test.faults.seed", 11)
+        s.set("spark.rapids.sql.format.scanCache.maxBytes", 0)
+    if tag is not None:
+        s.set("spark.rapids.sql.test.faults.queryTag", tag)
+    for k, v in extra.items():
+        s.set(k, v)
+    return s
+
+
+def _policy(weights="8,3,1", bound=8):
+    return QosPolicy(weights, bound)
+
+
+def _drain(q):
+    out = []
+    while len(q):
+        e, engaged = q.pop_next()
+        out.append((e.qos_class, e.cost_ms, e.seq, engaged))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# WFQ policy units: determinism, weights, SJF, starvation bound
+# ---------------------------------------------------------------------------
+
+def test_wfq_drain_order_deterministic_under_seeded_schedule():
+    """The drain order of a seeded arrival schedule is identical across
+    runs — no clocks, no randomness in the policy."""
+    import random
+
+    def build():
+        rng = random.Random(7)
+        q = WfqQueue(parse_weights("8,3,1"), 8)
+        for i in range(60):
+            cls = Q.CLASSES[rng.randrange(3)]
+            cost = rng.choice([None, float(rng.randrange(1, 500))])
+            q.push(cls, cost, threading.Event(), f"t{i % 4}")
+        return q
+
+    first = _drain(build())
+    second = _drain(build())
+    assert first == second
+    assert len(first) == 60
+
+
+def test_wfq_service_tracks_weight_vector():
+    """With every class backlogged, grants over a window split close to
+    the weight vector (stride scheduling property)."""
+    q = WfqQueue(parse_weights("8,3,1"), 1000)   # bound out of the way
+    for i in range(120):
+        for cls in Q.CLASSES:
+            q.push(cls, float(i), threading.Event())
+    got = {cls: 0 for cls in Q.CLASSES}
+    for _ in range(60):                          # 5 full stride cycles
+        e, _engaged = q.pop_next()
+        got[e.qos_class] += 1
+    assert got["interactive"] == 40
+    assert got["batch"] == 15
+    assert got["background"] == 5
+
+
+def test_wfq_sjf_within_class_unpriced_last_fifo():
+    """Within one class: priced entries shortest-first; un-priced ones
+    after every priced entry, FIFO among themselves."""
+    q = WfqQueue(parse_weights("8,3,1"), 8)
+    q.push("batch", None, threading.Event())       # seq 1, un-priced
+    q.push("batch", 90.0, threading.Event())       # seq 2
+    q.push("batch", None, threading.Event())       # seq 3, un-priced
+    q.push("batch", 10.0, threading.Event())       # seq 4
+    order = [(c, s) for c, _cost, s, _e in _drain(q)]
+    assert order == [("batch", 4), ("batch", 2), ("batch", 1),
+                     ("batch", 3)]
+
+
+def test_wfq_starvation_bound_engages():
+    """Weights 100:1:1 give background its first grant on fair stride
+    terms, but its vtime then jumps a full 1.0 — the stride schedule
+    alone would make it wait ~100 interactive grants for the second.
+    The hard bound caps that wait at 3 bypasses, flagged as an
+    engagement."""
+    q = WfqQueue(parse_weights("100,1,1"), 3)
+    q.push("background", 1.0, threading.Event())
+    q.push("background", 2.0, threading.Event())
+    for i in range(20):
+        q.push("interactive", float(i), threading.Event())
+    drained = []
+    for _ in range(6):
+        e, engaged = q.pop_next()
+        drained.append((e.qos_class, engaged))
+    assert drained == [
+        ("interactive", False),       # vtime tie -> class rank
+        ("background", False),        # fair stride grant, vtime -> 1.0
+        ("interactive", False),       # 3 bypasses build up...
+        ("interactive", False),
+        ("interactive", False),
+        ("background", True),         # ...the hard bound fires
+    ]
+    assert drained[5] == ("background", True)
+
+
+def test_wfq_reactivation_joins_at_global_vtime():
+    """A class idle for many grants re-enters at the CURRENT virtual
+    time — it cannot cash in credit for the idle stretch and then
+    monopolize the queue."""
+    q = WfqQueue(parse_weights("1,1,1"), 1000)
+    for i in range(10):
+        q.push("interactive", float(i), threading.Event())
+    for _ in range(10):
+        q.pop_next()                  # interactive vtime advances to 10
+    q.push("background", 1.0, threading.Event())
+    q.push("interactive", 99.0, threading.Event())
+    cq = q._classes["background"]
+    assert cq.vtime >= 9.0            # joined at global vtime, not 0
+    # One grant each way — background is NOT owed 10 back-to-back slots.
+    first, _ = q.pop_next()
+    assert first.qos_class == "background"
+    second, _ = q.pop_next()
+    assert second.qos_class == "interactive"
+
+
+def test_wfq_discard_is_race_free():
+    q = WfqQueue(parse_weights("8,3,1"), 8)
+    keep = q.push("batch", 5.0, threading.Event())
+    drop = q.push("batch", 1.0, threading.Event())
+    q.discard(drop)
+    assert len(q) == 1
+    e, _ = q.pop_next()
+    assert e is keep
+    assert q.pop_next() == (None, False)
+
+
+def test_parse_weights_and_resolve_class_validation():
+    assert parse_weights(" 8, 3 ,1 ") == {
+        "interactive": 8.0, "batch": 3.0, "background": 1.0}
+    with pytest.raises(ValueError, match="3 comma-separated"):
+        parse_weights("8,3")
+    with pytest.raises(ValueError, match="must be > 0"):
+        parse_weights("8,0,1")
+    assert Q.resolve_class(None) == "batch"
+    assert Q.resolve_class(" Interactive ") == "interactive"
+    with pytest.raises(ValueError, match="unknown priority class"):
+        Q.resolve_class("realtime")
+    assert Q.resolve_tenant(None) == "default"
+    assert Q.resolve_tenant("  acme ") == "acme"
+
+
+# ---------------------------------------------------------------------------
+# Structured rejection (satellite: QueryRejectedError regression)
+# ---------------------------------------------------------------------------
+
+def test_rejection_error_structured_fields_queue_full():
+    """Both scheduler modes shed load with kind/queue_depth/
+    retry_after_ms populated — and the message regression-matched by the
+    pre-QoS tests ("queue full") is unchanged."""
+    for qos in (None, _policy()):
+        mgr = QueryManager(1, 0, 50, qos=qos)
+        hog = mgr.admit()
+        try:
+            with pytest.raises(QueryRejectedError,
+                               match="queue full") as ei:
+                mgr.admit()
+            err = ei.value
+            assert err.kind == "queue-full"
+            assert err.queue_depth == 0
+            assert err.retry_after_ms is not None \
+                and err.retry_after_ms >= 50.0
+            assert "REJECTED" in str(err)
+        finally:
+            mgr.finish(hog)
+
+
+def test_rejection_error_structured_fields_admission_timeout():
+    for qos in (None, _policy()):
+        mgr = QueryManager(1, 4, 30, qos=qos)
+        hog = mgr.admit()
+        try:
+            with pytest.raises(QueryRejectedError,
+                               match="timeout") as ei:
+                mgr.admit()
+            err = ei.value
+            assert err.kind == "admission-timeout"
+            assert err.queue_depth == 0       # waiter removed first
+            assert err.retry_after_ms is not None
+        finally:
+            mgr.finish(hog)
+    assert Q.counters().get("rejected.admission-timeout", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Manager-level WFQ grant order vs FIFO
+# ---------------------------------------------------------------------------
+
+def _grant_order(mgr, submissions):
+    """Admit ``submissions`` [(priority, cost_ms), ...] while a hog
+    holds the only slot; return the observed grant order."""
+    hog = mgr.admit()
+    order = []
+    lock = threading.Lock()
+    started = threading.Semaphore(0)
+
+    def waiter(prio, cost):
+        started.release()
+        t = mgr.admit(None, priority=prio, cost_ms=cost)
+        with lock:
+            order.append((prio, cost))
+        mgr.finish(t)
+
+    threads = []
+    for prio, cost in submissions:
+        th = threading.Thread(target=waiter, args=(prio, cost))
+        th.start()
+        threads.append(th)
+        started.acquire()
+        deadline = time.monotonic() + 10
+        while mgr.queued_count < len(threads) \
+                and time.monotonic() < deadline:
+            time.sleep(0.002)
+    assert mgr.queued_count == len(submissions)
+    mgr.finish(hog)
+    for th in threads:
+        th.join(30)
+    return order
+
+
+def test_wfq_grant_order_beats_arrival_order():
+    """QoS mode: grants follow class priority + SJF, not arrival."""
+    mgr = QueryManager(1, 8, 30000, qos=_policy())
+    order = _grant_order(mgr, [("background", 1.0), ("batch", 50.0),
+                               ("batch", 5.0), ("interactive", 99.0)])
+    # Stride order, not arrival order: interactive first (rank on the
+    # vtime tie), then batch's SHORTER job (SJF), then background's
+    # fair-share grant, then the longer batch job.
+    assert order == [("interactive", 99.0), ("batch", 5.0),
+                     ("background", 1.0), ("batch", 50.0)]
+    assert Q.counters().get("admitted.interactive") == 1
+    assert Q.counters().get("admitted.batch") == 3   # incl. the hog
+    assert Q.counters().get("admitted.background") == 1
+
+
+def test_fifo_mode_ignores_priority_and_cost():
+    """scheduler.qos.enabled=false: the kwargs are accepted but grants
+    stay in arrival order and tickets carry no class."""
+    mgr = QueryManager(1, 8, 30000)          # no QosPolicy: FIFO
+    order = _grant_order(mgr, [("background", 1.0), ("batch", 50.0),
+                               ("interactive", 99.0)])
+    assert order == [("background", 1.0), ("batch", 50.0),
+                     ("interactive", 99.0)]
+    assert "admitted.interactive" not in Q.counters()
+    t = mgr.admit(None, priority="interactive", tenant="acme")
+    assert t.qos_class is None and t.tenant == "acme"
+    mgr.finish(t)
+
+
+def test_qos_disabled_by_default_and_gate_resizes_manager(monkeypatch):
+    """The default-off gate: a plain conf builds the FIFO manager; the
+    same process flips to QoS and back only through the idle-only
+    resize.  SRT_QOS is cleared so the test pins the DEFAULT even in
+    the qos-on CI matrix entry (where the env turns the gate on)."""
+    monkeypatch.delenv("SRT_QOS", raising=False)
+    assert Q.qos_enabled(TpuSession().conf) is False
+    mgr = SC.get_query_manager(TpuSession().conf)
+    assert mgr.qos is None
+    mgr2 = SC.get_query_manager(_qos_session().conf)
+    assert mgr2.qos is not None and mgr2.qos.sig == ("8,3,1", 8)
+    s = _qos_session()
+    s.set("spark.rapids.sql.scheduler.qos.weights", "4,2,1")
+    assert SC.get_query_manager(s.conf).qos.sig == ("4,2,1", 8)
+    assert SC.get_query_manager(TpuSession().conf).qos is None
+
+
+# ---------------------------------------------------------------------------
+# Deadline-aware admission
+# ---------------------------------------------------------------------------
+
+def test_deadline_reject_at_admit_vs_unpriced_pass():
+    """A priced query that cannot meet its deadline rejects at admit
+    (no slot burned, no retry hint); an un-priced one passes and relies
+    on the in-flight kill timer."""
+    conf = _qos_session().conf
+    mgr = QueryManager(2, 4, 1000, qos=_policy())
+    with pytest.raises(QueryRejectedError, match="unmeetable") as ei:
+        mgr.admit(conf, cost_ms=500.0, deadline_ms=50.0)
+    assert ei.value.kind == "deadline-unmeetable"
+    assert ei.value.retry_after_ms is None
+    assert mgr.active_count == 0
+    assert Q.counters().get("rejected.deadline-unmeetable") == 1
+    t = mgr.admit(conf, cost_ms=None, deadline_ms=50.0)   # un-priced
+    mgr.finish(t)
+    t = mgr.admit(conf, cost_ms=10.0, deadline_ms=50.0)   # meetable
+    mgr.finish(t)
+
+
+def test_deadline_slack_and_gate_conf():
+    conf = _qos_session(
+        **{"spark.rapids.sql.scheduler.qos.deadlineSlack": 3.0}).conf
+    mgr = QueryManager(2, 4, 1000, qos=_policy())
+    # 30ms estimate * 3.0 slack > 80ms deadline -> reject.
+    with pytest.raises(QueryRejectedError, match="unmeetable"):
+        mgr.admit(conf, cost_ms=30.0, deadline_ms=80.0)
+    off = _qos_session(**{
+        "spark.rapids.sql.scheduler.qos.deadlineAdmission.enabled":
+            False}).conf
+    t = mgr.admit(off, cost_ms=500.0, deadline_ms=50.0)
+    mgr.finish(t)
+
+
+def test_deadline_reject_at_admit_end_to_end(data_dir):
+    """With the cost model on, collect(timeout_ms=...) under QoS feeds
+    the estimate into admission: an absurd deadline rejects BEFORE
+    execution; the same query with a sane deadline runs."""
+    s = _qos_session(**{"spark.rapids.sql.cost.enabled": True})
+    df = tpch.QUERIES["q6"](s, data_dir)
+    with pytest.raises(QueryRejectedError, match="unmeetable"):
+        df.collect(timeout_ms=0.0001)
+    assert SC.get_query_manager().active_count == 0
+    assert df.collect(timeout_ms=120000) \
+        == tpch.QUERIES["q6"](_qos_session(), data_dir).collect()
+
+
+def test_deadline_kill_in_flight_still_works(data_dir):
+    """Un-priced queries (cost model off, the test default) pass
+    admission; the armed deadline still kills them mid-flight — QoS
+    does not replace the in-flight backstop."""
+    s = _qos_session(tag=3, chaos="stall@upload/query=3:1")
+    df = tpch.QUERIES["q6"](s, data_dir)
+    with pytest.raises(QueryCancelledError, match="deadline"):
+        df.collect(timeout_ms=300)
+    ctx = df._physical().last_ctx
+    assert ctx is not None and ctx.last_leak_report == []
+    assert SC.counters().get("deadlineKills", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant quotas
+# ---------------------------------------------------------------------------
+
+def test_tenant_in_flight_quota():
+    conf = _qos_session(
+        **{"spark.rapids.sql.scheduler.qos.tenantMaxInFlight": 1}).conf
+    mgr = QueryManager(4, 8, 1000, qos=_policy())
+    t1 = mgr.admit(conf, tenant="a")
+    with pytest.raises(QueryRejectedError, match="in-flight cap") as ei:
+        mgr.admit(conf, tenant="a")
+    assert ei.value.kind == "tenant-quota"
+    assert ei.value.retry_after_ms is not None
+    t2 = mgr.admit(conf, tenant="b")          # other tenants unaffected
+    mgr.finish(t1)
+    t3 = mgr.admit(conf, tenant="a")          # cap freed with the query
+    mgr.finish(t2)
+    mgr.finish(t3)
+    assert Q.counters().get("rejected.tenant-quota") == 1
+
+
+def test_tenant_catalog_bytes_quota():
+    """The owner-tagged catalog accounting (BufferCatalog.owned_bytes)
+    backs the byte cap: a tenant sitting on registered bytes rejects,
+    other tenants admit."""
+
+    class _Catalog:
+        def __init__(self, owned):
+            self._owned = owned
+
+        def owned_bytes(self):
+            return dict(self._owned)
+
+    class _Ctx:
+        def __init__(self, owned):
+            self._catalog = _Catalog(owned)
+
+    conf = _qos_session(**{
+        "spark.rapids.sql.scheduler.qos.tenantMaxCatalogBytes": 1024}).conf
+    mgr = QueryManager(4, 8, 1000, qos=_policy())
+    t1 = mgr.admit(conf, tenant="a")
+    mgr.register_context(t1, _Ctx({t1.query_id: 4096}))
+    with pytest.raises(QueryRejectedError,
+                       match="catalog-bytes cap") as ei:
+        mgr.admit(conf, tenant="a")
+    assert ei.value.kind == "tenant-quota"
+    t2 = mgr.admit(conf, tenant="b")
+    mgr.finish(t1)                            # bytes retire with the query
+    t3 = mgr.admit(conf, tenant="a")
+    for t in (t2, t3):
+        mgr.finish(t)
+
+
+def test_tenant_kernel_cache_quota_evicts_oldest():
+    """Over the compile budget the tenant's OLDEST kernel-cache entries
+    are evicted (quotaEvictions) — admission never rejects for it."""
+    from spark_rapids_tpu.ops import kernel_cache as KC
+    conf = _qos_session(**{
+        "spark.rapids.sql.scheduler.qos.tenantMaxKernelCacheEntries":
+            3}).conf
+    mgr = QueryManager(4, 8, 1000, qos=_policy())
+    t1 = mgr.admit(conf, tenant="kq")
+    # Query ids restart per manager while the kernel cache is process
+    # global: drop any stale same-id entries earlier tests compiled so
+    # the eviction accounting below is exact.
+    KC.cache().evict_owned({t1.query_id}, keep=0)
+    faults.set_query_token(t1.token)
+    try:
+        for i in range(5):
+            KC.cache().get(("qos-quota-test", i), lambda: i)
+    finally:
+        faults.set_query_token(None)
+    owned = [k for k, qid in KC.cache().owners().items()
+             if qid == t1.query_id]
+    assert len(owned) == 5
+    t2 = mgr.admit(conf, tenant="kq")         # admits; budget enforced
+    owned = [k for k, qid in KC.cache().owners().items()
+             if qid == t1.query_id]
+    assert len(owned) == 3
+    assert sorted(k[1] for k in owned) == [2, 3, 4]   # oldest two gone
+    assert Q.counters().get("quotaEvictions") == 2
+    for t in (t1, t2):
+        mgr.finish(t)
+    KC.cache().evict_owned({t1.query_id}, keep=0)     # leave no residue
+
+
+def test_tenant_quotas_bookkeeping_units():
+    tq = TenantQuotas()
+    tq.reserve("a")
+    tq.reserve("a")
+    tq.reserve("b")
+    assert tq.inflight("a") == 2 and tq.inflight("b") == 1
+    tq.release("a")
+    tq.release("b")
+    tq.release("b")                           # over-release clamps at 0
+    assert tq.inflight("a") == 1 and tq.inflight("b") == 0
+    tq.record_query(7, "a")
+    tq.record_query(8, "b")
+    assert tq.tenant_of(7) == "a" and tq.tenant_of(None) is None
+    assert tq.query_ids("a") == {7}
+    assert tq.kernel_entries("a", {"k1": 7, "k2": 8, "k3": None}) == 1
+    tq.prune(live_query_ids={8})
+    assert tq.tenant_of(7) is None
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant attribution without QoS (the bench sustained block)
+# ---------------------------------------------------------------------------
+
+def test_per_tenant_plan_cache_counters(data_dir):
+    """Tenant-tagged collects feed planCacheHit/Miss.<tenant> counters
+    in BOTH scheduler modes (attribution, not scheduling)."""
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    df = tpch.QUERIES["q6"](s, data_dir)
+    df.collect(tenant="acme")
+    df2 = tpch.QUERIES["q6"](TpuSession().set(
+        "spark.rapids.sql.variableFloatAgg.enabled", True), data_dir)
+    df2.collect(tenant="acme")
+    got = Q.counters()
+    assert got.get("planCacheMiss.acme", 0) \
+        + got.get("planCacheHit.acme", 0) >= 2
+    assert got.get("planCacheHit.acme", 0) >= 1, got
+
+
+# ---------------------------------------------------------------------------
+# Cross-tenant chaos isolation
+# ---------------------------------------------------------------------------
+
+def test_per_tenant_chaos_invisible_to_other_tenants(data_dir):
+    """QoS mode, 3 tenants in flight, seeded per-query chaos scoped to
+    tenant A's query tag: A recovers (faults actually injected), B and
+    C return bit-identical results with ZERO recovery counters."""
+    baseline = {qn: tpch.QUERIES[qn](_qos_session(), data_dir).collect()
+                for qn in ("q1", "q3", "q6")}
+    chaos = "oom@upload/query=1:1,lostoutput@exchange.serve/query=1:1"
+    plan = [("A", 1, "q3", "interactive"), ("B", 2, "q6", "batch"),
+            ("C", 3, "q1", "background")]
+    results, errors, dfs = {}, {}, {}
+    barrier = threading.Barrier(len(plan), timeout=60)
+
+    def run(name, tag, qn, prio):
+        try:
+            df = tpch.QUERIES[qn](_qos_session(tag=tag, chaos=chaos),
+                                  data_dir)
+            dfs[name] = df
+            barrier.wait()
+            results[name] = df.collect(priority=prio,
+                                       tenant=f"tenant-{name}")
+        except BaseException as e:       # pragma: no cover - diagnostics
+            errors[name] = e
+
+    threads = [threading.Thread(target=run, args=args) for args in plan]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not errors, errors
+    for name, _tag, qn, _p in plan:
+        assert results[name] == baseline[qn], \
+            f"tenant {name} ({qn}) diverged under chaos"
+
+    def rec(df):
+        m = df.metrics().get("Recovery@query", {})
+        return {k: v for k, v in m.items() if v}
+
+    assert rec(dfs["A"]).get("faultsInjected", 0) > 0
+    for name in ("B", "C"):
+        assert rec(dfs[name]) == {}, \
+            f"tenant {name}'s isolation was breached: {rec(dfs[name])}"
+    admitted = Q.counters()
+    for cls in Q.CLASSES:
+        assert admitted.get(f"admitted.{cls}", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# The soak (slow; 200 queries in CI, SRT_SOAK=1 runs the full 1000)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_qos_soak_mixed_tenants(data_dir):
+    """ISSUE 14 acceptance soak: mixed parameterized queries x 4
+    tenants (one per priority class + one chaos tenant) through the QoS
+    scheduler at maxConcurrentQueries=4. Every result is bit-identical
+    to its solo run, p99 latency stays bounded vs serial, every query's
+    leak report is empty, the chaos tenant's faults never cross the
+    tenant boundary, and the background class keeps progressing."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col, lit_col
+
+    total = 1000 if os.environ.get("SRT_SOAK", "").strip() \
+        not in ("", "0") else 200
+    day0 = tpch.days("1994-01-01")
+
+    def shape_q6(s, i):
+        li = tpch._read(s, data_dir, "lineitem")
+        lo = day0 + (i % 8) * 30
+        f = li.filter(
+            (col("l_shipdate") >= lit_col(lo))
+            & (col("l_shipdate") < lit_col(lo + 45))
+            & (col("l_discount") >= 0.02) & (col("l_quantity") < 30.0))
+        return f.agg(agg_sum(col("l_extendedprice") * col("l_discount"))
+                     .alias("rev"))
+
+    def shape_sum(s, i):
+        li = tpch._read(s, data_dir, "lineitem")
+        return li.filter(col("l_quantity") < float(5 + (i % 8) * 4)) \
+            .agg(agg_sum(col("l_extendedprice")).alias("s"))
+
+    shapes = [shape_q6, shape_sum]
+
+    def key(i):
+        return (i % len(shapes), (i // len(shapes)) % 8)
+
+    tenants = [("interactive", None, ""), ("batch", None, ""),
+               ("background", None, ""),
+               ("batch", 9, "oom@upload/query=9:2")]
+    sessions = [_qos_session(tag=tag, chaos=chaos)
+                for _cls, tag, chaos in tenants]
+    for s in sessions:
+        s.set("spark.rapids.sql.concurrentTpuTasks", 4)
+
+    # Solo reference pass: expected rows per (shape, literal) slot AND
+    # the serial latency baseline the p99 bound is measured against.
+    expected = {}
+    serial = []
+    ref = sessions[0]
+    for i in range(2 * len(shapes) * 8):
+        t0 = time.perf_counter()
+        rows = shapes[i % len(shapes)](ref, i).collect()
+        serial.append(time.perf_counter() - t0)
+        expected.setdefault(key(i), rows)
+    serial.sort()
+    serial_p50 = serial[len(serial) // 2]
+
+    # Warm every client's session (template plan + kernel compile per
+    # conf) before the timed run — the serving-tier steady state the
+    # latency bound is specified against.
+    for k, s in enumerate(sessions):
+        for i in range(len(shapes)):
+            shapes[i](s, i).collect(tenant=f"tenant{k}")
+
+    lock = threading.Lock()
+    lat = {k: [] for k in range(len(tenants))}
+    done = {k: 0 for k in range(len(tenants))}
+    failures = []
+    per_client = total // len(tenants)
+
+    def client(k):
+        cls, _tag, chaos = tenants[k]
+        s = sessions[k]
+        for j in range(per_client):
+            i = k * per_client + j
+            df = shapes[i % len(shapes)](s, i)
+            t0 = time.perf_counter()
+            try:
+                rows = df.collect(priority=cls, tenant=f"tenant{k}")
+            except BaseException as e:  # pragma: no cover - diagnostics
+                with lock:
+                    failures.append((k, i, repr(e)))
+                return
+            took = time.perf_counter() - t0
+            ctx = df._physical().last_ctx
+            with lock:
+                lat[k].append(took)
+                done[k] += 1
+                if rows != expected[key(i)]:
+                    failures.append((k, i, "rows diverged from solo run"))
+                if ctx is None or ctx.last_leak_report != []:
+                    failures.append((k, i, "leaked buffers"))
+                if not chaos:
+                    m = df.metrics().get("Recovery@query", {})
+                    hit = {kk: v for kk, v in m.items() if v}
+                    if hit:
+                        failures.append((k, i, f"chaos crossed: {hit}"))
+
+    threads = [threading.Thread(target=client, args=(k,),
+                                name=f"qos-soak-{k}")
+               for k in range(len(tenants))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(900)
+    assert failures == [], failures[:10]
+    assert all(done[k] == per_client for k in done), done
+
+    # p99 vs serial: the acceptance bound, with a small absolute floor
+    # so millisecond-scale CI queries don't fail on timer jitter.
+    clean = sorted(x for k in (0, 1, 2) for x in lat[k])
+    p99 = clean[min(int(0.99 * len(clean)), len(clean) - 1)]
+    bound = max(2.0 * serial_p50, serial_p50 + 0.25)
+    assert p99 <= bound, \
+        f"p99 {p99 * 1000:.1f}ms > bound {bound * 1000:.1f}ms " \
+        f"(serial p50 {serial_p50 * 1000:.1f}ms)"
+
+    got = Q.counters()
+    # Background kept progressing the whole soak under heavier classes.
+    assert got.get("admitted.background", 0) >= per_client
+    assert got.get("admitted.interactive", 0) >= per_client
+    # The chaos tenant actually injected faults (the isolation above
+    # was tested against something real).
+    assert faults.counters().get("faultsInjected", 0) > 0
+    # Per-tenant plan-cache counters saw every clean tenant (the chaos
+    # tenant bypasses the plan cache by design — an armed fault
+    # schedule targets per-plan state).
+    for k in (0, 1, 2):
+        assert got.get(f"planCacheHit.tenant{k}", 0) > 0, got
