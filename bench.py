@@ -259,6 +259,10 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         s = _session()
         s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 4)
         s.set("spark.rapids.sql.planCache.enabled", bool(cache))
+        # The sustained block doubles as the live-telemetry acceptance
+        # probe: metrics on, and the block's own JSON is reconciled
+        # against an HTTP scrape taken right after the load drains.
+        s.set("spark.rapids.sql.metrics.enabled", True)
         return s
 
     day0 = tpch.days("1994-01-01")
@@ -289,6 +293,15 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
     for i, sh in enumerate(shapes):         # cold: template + compile
         sh(s, i).collect()
     warmup_s = time.perf_counter() - t0
+
+    from spark_rapids_tpu.monitoring import telemetry as _tm
+
+    def _queries_total(text: str) -> float:
+        return sum(float(ln.rsplit(" ", 1)[1])
+                   for ln in text.splitlines()
+                   if ln.startswith("srt_queries_total"))
+
+    tm_base = _queries_total(_tm.render_text()) if _tm.enabled() else None
     c0 = _pc.counters()
     from spark_rapids_tpu.parallel import qos as _qos
     q0c = _qos.counters()
@@ -328,6 +341,29 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
     for w in workers:
         w.join()
     wall = time.perf_counter() - t0
+    # Scrape reconciliation: a REAL OpenMetrics HTTP scrape, taken the
+    # instant the load drains, must agree (±1 for an in-flight
+    # straggler) with this block's own completion count — the proof the
+    # exposition path reports the same world the bench JSON does.
+    telemetry_js = None
+    if _tm.enabled() and tm_base is not None:
+        try:
+            import urllib.request
+            from spark_rapids_tpu.monitoring import exporter as _exp
+            port = _exp.ensure_started(0)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                scraped = r.read().decode()
+            delta = _queries_total(scraped) - tm_base
+            expect = len(lat) + errors[0]
+            telemetry_js = {
+                "scrape_port": port,
+                "scraped_queries_total_delta": delta,
+                "bench_completions": expect,
+                "reconciles": abs(delta - expect) <= 1,
+            }
+        except Exception as e:
+            telemetry_js = {"error": f"{type(e).__name__}: {e}"}
     c1 = _pc.counters()
     q1c = _qos.counters()
     hits = c1.get("planCacheHits", 0) - c0.get("planCacheHits", 0)
@@ -366,6 +402,7 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         "q6_replan_retrace_s": round(off_s, 4),
         "q6_speedup_vs_plan_cache_off": round(off_s / on_s, 2)
         if on_s > 0 else None,
+        "telemetry": telemetry_js,
         "tenants": {
             f"client{k}": {
                 "plan_cache_hits": int(
@@ -942,6 +979,25 @@ def main():
             nt.setdefault(name, 0)
         nt["calibration"] = _cost.calibration_state()
         out["native"] = nt
+        from spark_rapids_tpu.monitoring import telemetry as _tm
+        if _tm.enabled():
+            # Compact registry rollup (the sustained block flips metrics
+            # on, so a full bench run always carries this): the query
+            # counter series plus how many series/metrics exist at exit.
+            snap = _tm.snapshot()["metrics"]
+            out["telemetry"] = {
+                "enabled": True,
+                "metrics": len(snap),
+                "series": sum(len(m["series"]) for m in snap.values()),
+                "queries_by_series": {
+                    ",".join(f"{k}={v}" for k, v in
+                             sorted(s["labels"].items())) or "-":
+                    s["value"]
+                    for s in snap.get("srt_queries",
+                                      {}).get("series", [])},
+            }
+        else:
+            out["telemetry"] = {"enabled": False}
         _STATE["done"] = True
         _emit(out)
     # No completed query = nothing measured: that is a failure signal even

@@ -1,0 +1,76 @@
+"""Post-hoc query-history reader — the history-server CLI.
+
+Reads the persistent JSONL event log written at query teardown
+(``spark.rapids.sql.eventLog.dir`` / ``SRT_EVENT_LOG``; see
+``spark_rapids_tpu/monitoring/history.py``) and reconstructs, after
+every process that ran the queries has exited:
+
+- per-query ``explain_analyze``-style node reports (observed
+  rows/bytes/wall per plan node, span-category breakdown, recovery
+  instants, bind-slot values, plan provenance);
+- a fleet summary (query counts by status/class/tenant, distinct
+  plans, plan-cache hit count, p50/p99 latency).
+
+Usage::
+
+    python scripts/history.py /tmp/srt-events            # list queries
+    python scripts/history.py /tmp/srt-events --query 3  # one report
+    python scripts/history.py /tmp/srt-events --summary  # fleet rollup
+
+``PATH`` is the event-log directory (every ``*.jsonl`` inside, merged
+and time-sorted) or a single log file. Stdlib-only, like the writer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from spark_rapids_tpu.monitoring import history  # noqa: E402
+
+
+def _list(records) -> None:
+    for rec in records:
+        dur = rec.get("duration_ms", 0.0) or 0.0
+        print(f"query {rec.get('query_id')}  [{rec.get('status')}]  "
+              f"class={rec.get('class') or '-'}  "
+              f"tenant={rec.get('tenant') or '-'}  "
+              f"wall={dur:.1f}ms  plan={rec.get('plan_fingerprint')}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="event-log directory or .jsonl file")
+    ap.add_argument("--query", type=int, default=None,
+                    help="render the full report of ONE query id "
+                         "(latest record wins when ids repeat)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print the fleet summary JSON instead of the "
+                         "per-query listing")
+    args = ap.parse_args(argv)
+
+    records = history.read_events(args.path)
+    if not records:
+        print(f"no event-log records under {args.path}", file=sys.stderr)
+        return 1
+    if args.query is not None:
+        matches = [r for r in records if r.get("query_id") == args.query]
+        if not matches:
+            print(f"no record for query {args.query}", file=sys.stderr)
+            return 1
+        print(history.render_report(matches[-1]))
+        return 0
+    if args.summary:
+        print(json.dumps(history.fleet_summary(records), indent=2,
+                         sort_keys=True))
+        return 0
+    _list(records)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,7 @@ def main():
 
     native_bench()
     trace_overhead()
+    telemetry_overhead()
 
 
 def native_bench():
@@ -259,6 +260,35 @@ def trace_overhead(calls: int = 200_000, budget_ns: float = 3000.0):
           f"enabled={enabled:.0f} ns/call")
     assert disabled < budget_ns, \
         f"no-op trace span costs {disabled:.0f} ns/call (> {budget_ns})"
+
+
+def telemetry_overhead(calls: int = 200_000, budget_ns: float = 3000.0):
+    """Bound the metric registry's DISABLED cost: ``inc``/``observe``
+    with metrics off is a single module-global load and return, so the
+    instrumentation sites (collect funnel, scheduler admit/reject,
+    query teardown) must cost nanoseconds in the default-off
+    configuration. Same budget philosophy as :func:`trace_overhead` —
+    generous vs the tens-of-ns real cost, present to catch a lock or
+    allocation creeping ahead of the enabled check."""
+    from spark_rapids_tpu.monitoring import telemetry
+
+    def loop():
+        t0 = time.perf_counter_ns()
+        for _ in range(calls):
+            telemetry.inc("srt_bench_counter")
+            telemetry.observe("srt_bench_latency_ms", 1.0)
+        return (time.perf_counter_ns() - t0) / calls
+
+    telemetry.configure(False)
+    disabled = min(loop() for _ in range(3))
+    telemetry.configure(True)
+    enabled = min(loop() for _ in range(3))
+    telemetry.configure(False)
+    telemetry.reset()
+    print(f"telemetry inc+observe: disabled={disabled:.0f} ns/call "
+          f"enabled={enabled:.0f} ns/call")
+    assert disabled < budget_ns, \
+        f"no-op telemetry costs {disabled:.0f} ns/call (> {budget_ns})"
 
 
 if __name__ == "__main__":

@@ -14,6 +14,11 @@ The gate measures three headline numbers (ROADMAP item 1's "lock it in"):
                       through the ingest fast path (the same probe that
                       produces bench.py's scan_gb_per_sec headline).
 
+A fourth probe, ``telemetry_noop_ns``, bounds the metrics-off cost of
+the live-telemetry instrumentation sites by an ABSOLUTE budget (no
+reference entry — the no-op is a single global load, immune to machine
+speed at the budget's scale).
+
 Machine normalization: absolute wall clock is meaningless across CI
 runners, so the gate first times a fixed numpy calibration workload and
 scales every latency by ``ref_calib_s / my_calib_s`` (and throughput by
@@ -150,12 +155,38 @@ def measure_scan_gbps() -> float:
     return nbytes / secs / 1e9 if secs > 0 else 0.0
 
 
+def measure_telemetry_noop_ns(calls: int = 200_000) -> float:
+    """Per-call cost of a metrics-off ``inc``+``observe`` pair — the
+    cost every instrumentation site adds in the default configuration.
+    Bounded by an ABSOLUTE budget (like microbench's trace-span
+    assert), not a reference metric: the no-op is a single global load,
+    so machine variance is irrelevant at the 3µs bound and the
+    reference file stays untouched."""
+    from spark_rapids_tpu.monitoring import telemetry
+    telemetry.configure(False)
+
+    def loop():
+        t0 = time.perf_counter_ns()
+        for _ in range(calls):
+            telemetry.inc("srt_gate_counter")
+            telemetry.observe("srt_gate_latency_ms", 1.0)
+        return (time.perf_counter_ns() - t0) / calls
+
+    best = min(loop() for _ in range(3))
+    telemetry.reset()
+    return best
+
+
+TELEMETRY_NOOP_BUDGET_NS = 3000.0
+
+
 def measure() -> dict:
     calib = calibration_s()
     out = {"calibration_s": round(calib, 4)}
     out.update(measure_compile_s())
     out["bind_only_ms"] = round(measure_bind_only_ms(), 3)
     out["scan_gbps"] = round(measure_scan_gbps(), 4)
+    out["telemetry_noop_ns"] = round(measure_telemetry_noop_ns(), 1)
     return out
 
 
@@ -185,6 +216,16 @@ def compare(measured: dict, reference: dict, tolerance: float) -> dict:
         report["metrics"][name] = {
             "measured": raw, "normalized": round(norm, 4),
             "reference": ref, "regressionPct": round(delta * 100, 1),
+            "ok": ok}
+        report["ok"] = report["ok"] and ok
+    # Absolute-budget metric (no reference entry, no normalization):
+    # the metrics-off telemetry no-op must stay in single-global-load
+    # territory on ANY machine.
+    noop = measured.get("telemetry_noop_ns")
+    if noop is not None:
+        ok = noop <= TELEMETRY_NOOP_BUDGET_NS
+        report["metrics"]["telemetry_noop_ns"] = {
+            "measured": noop, "budgetNs": TELEMETRY_NOOP_BUDGET_NS,
             "ok": ok}
         report["ok"] = report["ok"] and ok
     return report

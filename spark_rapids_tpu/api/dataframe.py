@@ -438,9 +438,28 @@ class DataFrame:
         DataFrame's last collect AND whatever ran concurrently — and
         one per worker thread. Requires ``spark.rapids.sql.trace.enabled``
         (or SRT_TRACE=1) during the collect; returns the trace document
-        and writes it to ``path`` when given."""
+        and writes it to ``path`` when given.
+
+        After a cluster collect, the workers' trace rings (shipped back
+        on stage completion) merge into this SAME document under their
+        own per-worker process tracks — one file shows the driver's
+        dispatch wait next to each worker's stage execution."""
         from spark_rapids_tpu import monitoring
-        return monitoring.export_chrome(path)
+        phys = self._physical()
+        ctx = getattr(phys, "last_ctx", None)
+        workers = ctx.cache.get("cluster_worker_events") \
+            if ctx is not None else None
+        if not workers:
+            return monitoring.export_chrome(path)
+        from spark_rapids_tpu.monitoring.chrome import to_chrome_cluster
+        doc = to_chrome_cluster(monitoring.events(),
+                                monitoring.thread_names(), workers,
+                                monitoring.process_tag())
+        if path:
+            import json
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc
 
     def to_pandas(self):
         import pandas as pd

@@ -472,7 +472,37 @@ UDF_COMPILER_ENABLED = conf("spark.rapids.sql.udfCompiler.enabled").doc(
 ).boolean(True)
 
 METRICS_ENABLED = conf("spark.rapids.sql.metrics.enabled").doc(
-    "Collect per-operator metrics (rows/batches/time).").boolean(True)
+    "Live telemetry plane (spark_rapids_tpu/monitoring/telemetry.py): "
+    "a process-global typed metric registry — monotonic counters, "
+    "gauges, sliding-window log-bucket histograms (p50/p95/p99) with "
+    "labeled series (tenant/class/kind/tier/worker) — continuously "
+    "scrapeable while queries run, bridged from every existing counter "
+    "funnel (scheduler/QoS, plan+kernel caches, recovery ladder, "
+    "transport, pipeline, spill watermark). Consumed by "
+    "telemetry.snapshot()/render_text(), the OpenMetrics exporter "
+    "(metrics.port) and bench.py's telemetry block. Off = a no-op "
+    "registry whose per-call cost is one global load (the same "
+    "discipline as trace.enabled; scripts/microbench.py bounds it). "
+    "The SRT_METRICS env (0/1) overrides the default for a whole "
+    "process.").boolean(False)
+
+METRICS_PORT = conf("spark.rapids.sql.metrics.port").doc(
+    "OpenMetrics/Prometheus exporter port (monitoring/exporter.py): "
+    "with metrics.enabled, serve the text exposition on "
+    "127.0.0.1:<port>/metrics from a daemon thread. 0 (default) = no "
+    "socket — the registry stays readable in-process via "
+    "telemetry.snapshot()/render_text().").integer(0)
+
+EVENT_LOG_DIR = conf("spark.rapids.sql.eventLog.dir").doc(
+    "Persistent per-query event log (monitoring/history.py): append "
+    "one JSONL record per query at teardown — plan fingerprint, bind "
+    "slots, per-node observed rows/bytes, span-category breakdown, "
+    "recovery/QoS instants, final metrics — under this directory "
+    "(one events-<pid>.jsonl per process). scripts/history.py "
+    "reconstructs explain_analyze-style reports and a fleet summary "
+    "from the log alone, after the process has exited (the history "
+    "server analog). Empty (default) = off. The SRT_EVENT_LOG env "
+    "overrides the default for a whole process.").string("")
 
 MESH_ENABLED = conf("spark.rapids.sql.mesh.enabled").doc(
     "Lower hash shuffles to collective all_to_all exchanges over the "
@@ -1349,6 +1379,34 @@ def generate_docs() -> str:
         "breakdown bench.py publishes as its `trace` JSON block.",
         "Disabled, the recorder is a shared no-op costing nanoseconds",
         "per call site — results and metrics are byte-identical either",
+        "way. See docs/observability.md.",
+        "",
+        "## Live telemetry & history",
+        "",
+        "With `spark.rapids.sql.metrics.enabled` (default false;",
+        "`SRT_METRICS=1` env override) every process keeps a typed",
+        "metric registry — counters, gauges and sliding-window",
+        "histograms with p50/p95/p99 — fed from the existing",
+        "scheduler/memory/cache/shuffle counter funnels plus per-query",
+        "labeled series (status, QoS class, tenant, rejection kind).",
+        "`spark.rapids.sql.metrics.port` (default 0 = off) additionally",
+        "serves the registry in OpenMetrics text format on a",
+        "localhost-only HTTP endpoint (`/metrics`, `/healthz`) for",
+        "Prometheus-style scraping; `telemetry.snapshot()` and",
+        "`telemetry.render_text()` expose the same view in-process",
+        "with zero dependencies. In cluster mode workers piggyback",
+        "metric deltas on their heartbeats, so the coordinator process",
+        "scrapes a fleet view with per-worker labels.",
+        "",
+        "`spark.rapids.sql.eventLog.dir` (default empty = off;",
+        "`SRT_EVENT_LOG` env override) appends one JSONL record per",
+        "query at teardown — status, class, tenant, plan fingerprint,",
+        "per-node observed rows/bytes/wall, span-category breakdown and",
+        "recovery instants. `scripts/history.py` reconstructs",
+        "explain_analyze-style reports and a fleet summary from the log",
+        "alone after every process has exited. Both gates are",
+        "exposition-only: disabled (the default) the hot paths reduce",
+        "to a single global load, and results are byte-identical either",
         "way. See docs/observability.md.",
         "",
         "## Native Pallas kernels",

@@ -1,4 +1,5 @@
-"""Query flight recorder + trace consumers (the observability substrate).
+"""Query flight recorder + live telemetry plane (the observability
+substrate).
 
 - :mod:`recorder` — bounded per-query ring buffers of spans/instants,
   with a near-zero disabled path (``spark.rapids.sql.trace.*``).
@@ -6,12 +7,19 @@
 - :mod:`analyze` — the ``explain_analyze`` renderer (observed metrics
   next to cost-model estimates).
 - :mod:`syncs` — host-sync funnel attribution on the same span stream.
+- :mod:`telemetry` — process-global typed metric registry (counters /
+  gauges / sliding-window histograms, ``spark.rapids.sql.metrics.*``),
+  with cluster fleet aggregation.
+- :mod:`exporter` — OpenMetrics HTTP scrape surface on localhost.
+- :mod:`history` — persistent per-query JSONL event log
+  (``spark.rapids.sql.eventLog.dir``) + post-hoc report readers.
 
 Import cost matters: this package (like faults.py) is imported from
-deep dispatch code, so the recorder stays stdlib-only and everything
-engine-shaped is lazy.
+deep dispatch code, so the recorder and telemetry stay stdlib-only and
+everything engine-shaped is lazy.
 """
 
+from spark_rapids_tpu.monitoring import history, telemetry  # noqa: F401
 from spark_rapids_tpu.monitoring.recorder import (     # noqa: F401
     LEVEL_KERNEL, LEVEL_OPERATOR, LEVEL_QUERY, category_breakdown,
     configure, enabled, events, export_chrome, instant, level,

@@ -54,3 +54,33 @@ def to_chrome(events: List[tuple], thread_names: Dict[int, str],
             ev["args"] = dict(args)
         trace.append(ev)
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+_WORKER_PID_STRIDE = 1_000_000
+
+
+def to_chrome_cluster(driver_events: List[tuple],
+                      driver_threads: Dict[int, str],
+                      worker_groups: Dict[str, tuple],
+                      process_tag: str = "") -> dict:
+    """ONE merged Perfetto document for a distributed query: the
+    driver's rings render as usual, then each worker's shipped ring
+    (the CDONE piggyback) is appended under its own process tracks.
+    Worker ``k``'s pids are offset by ``(k+1) * 1_000_000`` so a
+    worker's ring-0 events never collide with the driver's query
+    tracks, while its ``process_name`` metadata keeps the worker tag
+    ("worker w0 query 3"). ``worker_groups`` maps wid ->
+    ``(events, thread_names, tag)`` — the shape the coordinator
+    stashes in ``ctx.cache["cluster_worker_events"]``."""
+    doc = to_chrome(driver_events, driver_threads, process_tag)
+    trace = doc["traceEvents"]
+    for k, wid in enumerate(sorted(worker_groups)):
+        events, threads, tag = worker_groups[wid]
+        base = (k + 1) * _WORKER_PID_STRIDE
+        for ev in to_chrome(events, threads, tag)["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = base + ev["pid"]
+            if ev.get("name") == "process_sort_index":
+                ev["args"] = {"sort_index": ev["pid"]}
+            trace.append(ev)
+    return doc

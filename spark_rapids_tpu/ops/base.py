@@ -518,9 +518,11 @@ class Exec:
             # site runs (spark.rapids.sql.trace.*) — and its native
             # Pallas kernel gates, before any kernel traces
             # (spark.rapids.sql.native.*).
+            from spark_rapids_tpu.monitoring import telemetry
             from spark_rapids_tpu.ops import native
             wire.maybe_configure(ctx.conf)
             monitoring.maybe_configure(ctx.conf)
+            telemetry.maybe_configure(ctx.conf)
             native.maybe_configure(ctx.conf)
             # Task admission (GpuSemaphore.scala:74-87): at most
             # concurrentTpuTasks collects issue device work at once, so
@@ -533,6 +535,7 @@ class Exec:
             collect_span = monitoring.span(
                 "collect", "query", level=monitoring.LEVEL_QUERY,
                 args={"op": self.name})
+            t0_collect = time.perf_counter()
             collect_span.__enter__()
             try:
                 with sem:
@@ -625,6 +628,27 @@ class Exec:
                     rows.extend(hb.to_pylist())
             finally:
                 collect_span.__exit__(None, None, None)
+                # Live telemetry (the hot-collect instrumentation the
+                # microbench overhead probe models): one counter inc +
+                # one histogram observe per collect, plus the spill
+                # ladder's tier occupancy and device high watermark —
+                # read off the catalog only if this query built one.
+                telemetry.inc("srt_collects")
+                telemetry.observe(
+                    "srt_collect_ms",
+                    (time.perf_counter() - t0_collect) * 1e3)
+                cat = ctx._catalog
+                if cat is not None and telemetry.enabled():
+                    telemetry.set_gauge("srt_memory_bytes",
+                                        cat.device_bytes, tier="device")
+                    telemetry.set_gauge("srt_memory_bytes",
+                                        cat.host_bytes, tier="host")
+                    telemetry.set_gauge("srt_memory_bytes",
+                                        cat.disk_bytes, tier="disk")
+                    telemetry.set_gauge("srt_device_budget_bytes",
+                                        cat.device_budget)
+                    telemetry.max_gauge("srt_device_watermark_bytes",
+                                        cat.device_bytes)
             # Cost-model self-calibration: feed this query's observed
             # sync-span mean and upload throughput (plus the Cost@query
             # estimateErrorPct as a trust dampener) back into the
@@ -637,7 +661,9 @@ class Exec:
                 pass
         else:
             from spark_rapids_tpu import monitoring
+            from spark_rapids_tpu.monitoring import telemetry
             monitoring.maybe_configure(ctx.conf)
+            telemetry.maybe_configure(ctx.conf)
             with monitoring.span("collect", "query",
                                  level=monitoring.LEVEL_QUERY,
                                  args={"op": self.name,

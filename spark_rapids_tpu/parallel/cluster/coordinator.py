@@ -247,8 +247,13 @@ class QueryRun:
             int(conf.get(C.CLUSTER_STEAL_DELAY_MS)), 0) / 1000.0
         self.error: Optional[BaseException] = None
         self._ctx = None
+        self._root = None       # driver's unpickled plan root (submit)
         self._trace_qid = 0
         self.finished = False
+        # Latest per-worker CDONE stats blob (node stats + trace ring).
+        # Each report is cumulative for this query on that worker, so
+        # last-writer-wins per wid is the correct merge discipline.
+        self.worker_reports: Dict[str, dict] = {}
         # QoS class rank (parallel/qos/): CPOLL offers ready stages of
         # higher-priority queries first, BEFORE byte-score locality. The
         # default class ("batch", rank 1) keeps the (rank, qid) sort
@@ -312,6 +317,76 @@ class QueryRun:
             "cluster-dispatch-complete", "cluster",
             args={"query": self.qid, "stages": len(self.tasks),
                   "workers": len(workers)}, qid=self._trace_qid)
+        self._merge_worker_reports()
+
+    def _merge_worker_reports(self) -> None:
+        """Fold the workers' CDONE stats blobs into the driver's view:
+        per-node observed rows/bytes/wall land in ``ctx.metrics`` under
+        the driver's own operator instances (matched by the shared DFS
+        preorder index — both processes unpickled the same plan, so the
+        walk agrees), and each worker's shipped trace ring is stashed in
+        ``ctx.cache`` for the merged Perfetto export. The driver's own
+        observations always win; among workers, the report that saw the
+        most rows for a node wins (the producer saw the full output, a
+        stage that merely fetched it saw a fetch-side partial)."""
+        ctx, root = self._ctx, self._root
+        if ctx is None or root is None:
+            return
+        with self.co._lock:
+            reports = dict(self.worker_reports)
+        if not reports:
+            return
+        from spark_rapids_tpu.ops.base import Metrics
+        ops: List = []
+
+        def walk(op):
+            ops.append(op)
+            for c in op.children:
+                walk(c)
+
+        walk(root)
+        filled: Dict[str, float] = {}   # key -> best worker row count
+        events: Dict[str, tuple] = {}
+        for wid in sorted(reports):
+            rep = reports[wid]
+            for n in rep.get("nodes") or []:
+                i = n.get("idx")
+                if not isinstance(i, int) or i >= len(ops):
+                    continue
+                op = ops[i]
+                if op.name != n.get("name"):
+                    continue    # plan-shape mismatch: refuse to mislabel
+                vals: Dict[str, float] = {}
+                if n.get("rows") is not None:
+                    vals["numOutputRows"] = float(n["rows"])
+                if n.get("bytes") is not None:
+                    vals["numOutputBytes"] = float(n["bytes"])
+                if n.get("batches"):
+                    vals["numOutputBatches"] = float(n["batches"])
+                if n.get("wall_ms"):
+                    vals["totalTime"] = float(n["wall_ms"]) * 1e6
+                if not vals:
+                    continue
+                key = f"{op.name}@{id(op):x}"
+                m = ctx.metrics.get(key)
+                if m is not None and key not in filled:
+                    continue    # the driver observed this node itself
+                score = vals.get("numOutputRows",
+                                 vals.get("totalTime", 0.0) / 1e9)
+                if key in filled and filled[key] >= score:
+                    continue
+                filled[key] = score
+                m = ctx.metrics.setdefault(key, Metrics(owner=op.name))
+                with m._lock:
+                    m.values.clear()
+                    m.values.update(vals)
+            if rep.get("events"):
+                threads = {int(k): v for k, v in
+                           (rep.get("threads") or {}).items()}
+                events[wid] = (rep["events"], threads,
+                               rep.get("tag") or f"worker {wid}")
+        if events:
+            ctx.cache["cluster_worker_events"] = events
 
     def _progress(self) -> str:
         by = {}
@@ -461,11 +536,13 @@ class QueryRun:
         return line, best
 
     def _on_done_locked(self, wid: str, sid: int, gen: int,
-                        nbytes: int) -> None:
+                        nbytes: int, report: Optional[dict] = None) -> None:
         t = self.tasks.get(sid)
         if t is None or t.gen != gen or t.status != _RUNNING or \
                 t.worker != wid:
             return          # stale generation (zombie worker) — ignored
+        if report is not None:
+            self.worker_reports[wid] = report
         t.status = _DONE
         t.bytes = nbytes
         t.producer = wid
@@ -590,9 +667,21 @@ class ClusterCoordinator:
             with self._lock:
                 self._touch_locked(parts[1])
             return b"OK\n"
-        if cmd == "CBEAT" and len(parts) == 2:
+        if cmd == "CBEAT" and len(parts) in (2, 3):
             with self._lock:
                 self._touch_locked(parts[1])
+            if len(parts) == 3:
+                # Telemetry piggyback (monitoring/telemetry.py): the
+                # worker's flattened registry feeds the driver's fleet
+                # view — every series re-renders with worker=<wid>.
+                # Old-format beats (2 parts) stay valid forever.
+                try:
+                    from spark_rapids_tpu.monitoring import telemetry
+                    telemetry.fleet_update(parts[1], json.loads(
+                        base64.b64decode(parts[2]).decode()))
+                except Exception:
+                    _LOG.warning("cluster: bad CBEAT telemetry blob "
+                                 "from %s", parts[1], exc_info=True)
             return b"OK\n"
         if cmd == "CPOLL" and len(parts) == 3:
             wid, known = parts[1], parts[2]
@@ -612,14 +701,25 @@ class ClusterCoordinator:
                         line, _ = picked
                         return line.encode()
             return f"CIDLE {','.join(stale) or '-'}\n".encode()
-        if cmd == "CDONE" and len(parts) == 6:
-            _, wid, qid, sid, gen, nbytes = parts
+        if cmd == "CDONE" and len(parts) in (6, 7):
+            _, wid, qid, sid, gen, nbytes = parts[:6]
+            report = None
+            if len(parts) == 7:
+                # Per-node observed stats + trace ring piggyback (the
+                # cluster explain_analyze / merged-Perfetto plumbing).
+                # Old-format CDONEs (6 parts) stay valid forever.
+                try:
+                    report = json.loads(
+                        base64.b64decode(parts[6]).decode())
+                except Exception:
+                    _LOG.warning("cluster: bad CDONE report blob from "
+                                 "%s", wid, exc_info=True)
             with self._lock:
                 self._touch_locked(wid)
                 q = self.queries.get(int(qid))
                 if q is not None:
                     q._on_done_locked(wid, int(sid), int(gen),
-                                      int(nbytes))
+                                      int(nbytes), report=report)
             return b"OK\n"
         if cmd == "CFAIL" and len(parts) == 7:
             _, wid, qid, sid, gen, lost, b64 = parts
@@ -695,6 +795,7 @@ class ClusterCoordinator:
                            for sid in dispatchable}
             q = QueryRun(self, qid, conf, tasks, driver_tags)
             q._blob = blob
+            q._root = phys.root
             os.makedirs(q.qdir, exist_ok=True)
             self._write_plan(q)
             self.queries[qid] = q

@@ -41,6 +41,7 @@ if __name__ == "__main__":          # bare-script env hygiene, before jax
 
 import argparse
 import base64
+import json
 import logging
 import pickle
 import signal
@@ -124,8 +125,22 @@ class Worker:
             RendezvousUnavailableError
         interval = self.heartbeat_ms / 3000.0
         while not self._stop.wait(interval):
+            line = f"CBEAT {self.wid}"
             try:
-                self._call(f"CBEAT {self.wid}", timeout_s=5.0)
+                # Telemetry piggyback: the flattened local registry
+                # (cumulative absolutes, so a lost beat costs nothing)
+                # rides the heartbeat — the coordinator feeds it into
+                # the driver's fleet view with a worker label.
+                from spark_rapids_tpu.monitoring import telemetry
+                if telemetry.enabled():
+                    blob = base64.b64encode(json.dumps(
+                        telemetry.export_cluster_blob(),
+                        default=str).encode()).decode()
+                    line = f"CBEAT {self.wid} {blob}"
+            except Exception:          # a beat must never die on stats
+                pass
+            try:
+                self._call(line, timeout_s=5.0)
             except RendezvousUnavailableError:
                 # The main loop owns the exit decision; a missed beat
                 # on a live coordinator merely looks slow.
@@ -145,6 +160,7 @@ class Worker:
             root, raw, binds = pickle.loads(f.read())
         conf = C.TpuConf(raw)
         monitoring.maybe_configure(conf)
+        monitoring.telemetry.maybe_configure(conf)
         faults.maybe_configure(conf)
         graph, dispatchable, _ = stage_plan(root)
         tags = {id(graph.stages[sid].boundary): (sid, f"s{sid}")
@@ -232,7 +248,43 @@ class Worker:
         finally:
             st.info.set_local(None)
         self.tasks_done += 1
-        self._call(f"CDONE {self.wid} {qid} {sid} {gen} {nbytes}")
+        extra = self._stage_report(st)
+        self._call(f"CDONE {self.wid} {qid} {sid} {gen} {nbytes}"
+                   + (f" {extra}" if extra else ""))
+
+    def _stage_report(self, st: _QueryState) -> Optional[str]:
+        """b64(JSON) CDONE piggyback: this query's per-node observed
+        metrics in the shared DFS-preorder indexing (the driver merges
+        them into its own ctx so a cluster ``explain_analyze`` shows
+        worker-stage rows/bytes), plus — when the flight recorder is on
+        — this worker's trace ring and thread names for the driver's
+        merged one-file Perfetto export. Cumulative per query: each
+        CDONE supersedes the last, so the coordinator keeps only the
+        latest report per worker."""
+        try:
+            from spark_rapids_tpu import monitoring
+            from spark_rapids_tpu.monitoring import history
+            payload: dict = {}
+            nodes = [n for n in history.node_stats(st.root, st.ctx)
+                     if n["rows"] is not None or n["bytes"] is not None
+                     or n["batches"] or n["wall_ms"]]
+            if nodes:
+                payload["nodes"] = nodes
+            if monitoring.enabled():
+                payload["events"] = [list(e) for e in monitoring.events()]
+                payload["threads"] = {
+                    str(k): v
+                    for k, v in monitoring.thread_names().items()}
+                payload["tag"] = (monitoring.process_tag()
+                                  or f"worker {self.wid}")
+            if not payload:
+                return None
+            return base64.b64encode(
+                json.dumps(payload, default=str).encode()).decode()
+        except Exception:              # stats must never fail the task
+            _LOG.warning("worker %s: stage report build failed",
+                         self.wid, exc_info=True)
+            return None
 
     def _lost_dep(self, st: _QueryState, sid: int,
                   e: BaseException) -> Optional[int]:

@@ -92,6 +92,22 @@ def metrics_entry(ctx):
     return query_metrics_entry(ctx, "Scheduler")
 
 
+def _telemetry_reject(kind: str, depth: int, hint, tenant=None,
+                      qcls=None) -> None:
+    """Structured shed-load telemetry (monitoring/telemetry.py): every
+    QueryRejectedError's fields — kind, run-queue depth at rejection,
+    the retry-after EWMA hint — as labeled scrape series next to the
+    bare ``rejected`` funnel counter."""
+    from spark_rapids_tpu.monitoring import telemetry
+    if not telemetry.enabled():
+        return
+    telemetry.inc("srt_queries_rejected", kind=kind,
+                  tenant=str(tenant or "-"), **{"class": str(qcls or "-")})
+    telemetry.set_gauge("srt_reject_queue_depth", depth, kind=kind)
+    if hint is not None:
+        telemetry.set_gauge("srt_reject_retry_after_ms", hint, kind=kind)
+
+
 def record_plan_cache(ctx, hit: bool) -> None:
     """Per-tenant plan-cache outcome (plan/plan_cache.py) on the query's
     Scheduler@query entry plus the process counters bench.py's
@@ -241,11 +257,13 @@ class QueryManager:
                 return self._issue(tag, 0.0, cancel, tenant=tnt)
             if len(self._waiters) >= self.queue_depth:
                 _record("rejected")
+                _record("rejected.queue-full")
                 depth = len(self._waiters)
                 hint = self._retry_hint_locked()
                 from spark_rapids_tpu import monitoring
                 monitoring.instant("query-rejected", "recovery",
                                    args={"reason": "queue full"})
+                _telemetry_reject("queue-full", depth, hint, tenant=tnt)
                 raise QueryRejectedError(
                     f"run queue full ({depth} queued, "
                     f"{self.max_concurrent} running)",
@@ -275,8 +293,11 @@ class QueryManager:
                     raise faults.QueryCancelledError(
                         -1, "cancelled while queued")
                 _record("rejected")
+                _record("rejected.admission-timeout")
                 monitoring.instant("query-rejected", "recovery",
                                    args={"reason": "admission timeout"})
+                _telemetry_reject("admission-timeout", depth, hint,
+                                  tenant=tnt)
                 raise QueryRejectedError(
                     f"admission timeout after "
                     f"{self.admission_timeout_ms}ms "
@@ -326,6 +347,7 @@ class QueryManager:
                 "query-rejected", "recovery",
                 args={"reason": reason, "kind": kind, "tenant": tnt,
                       "class": qcls})
+            _telemetry_reject(kind, depth, hint, tenant=tnt, qcls=qcls)
             raise QueryRejectedError(reason, kind=kind, queue_depth=depth,
                                      retry_after_ms=hint)
 
@@ -412,6 +434,13 @@ class QueryManager:
             from spark_rapids_tpu.parallel import qos as Q
             Q._record(f"admitted.{qos_class}")
             self._qos.quotas.record_query(token.query_id, tenant)
+        from spark_rapids_tpu.monitoring import telemetry
+        if telemetry.enabled():
+            telemetry.inc("srt_queries_admitted",
+                          tenant=str(tenant or "-"),
+                          **{"class": str(qos_class or "-")})
+            telemetry.observe("srt_admission_queued_ms", queued_ms,
+                              **{"class": str(qos_class or "-")})
         # Retro-record the admission wait as a "queued" span on the
         # query's OWN track: the id the wait was for only exists now.
         from spark_rapids_tpu import monitoring

@@ -462,9 +462,11 @@ class PhysicalPlan:
         from spark_rapids_tpu.parallel import scheduler as SC
         from spark_rapids_tpu.parallel import stages as S
         owned = ctx is None
-        # Adopt the trace configuration BEFORE admission so the
-        # admission-queue span of THIS query records.
+        # Adopt the trace + telemetry configuration BEFORE admission so
+        # the admission-queue span AND the rejection counters of THIS
+        # query record (a shed query never reaches the dispatch funnel).
         monitoring.maybe_configure(self.conf)
+        monitoring.telemetry.maybe_configure(self.conf)
         # Multi-query admission (parallel/scheduler.py): one ticket per
         # top-level collect. A thread already carrying a token (a nested
         # collect issued by this same query — e.g. a gated write) rides
@@ -578,6 +580,9 @@ class PhysicalPlan:
         attempt = 0
         import logging
         log = logging.getLogger("spark_rapids_tpu")
+        t0_query = _time.perf_counter()
+        status = "ok"
+        err_text = None
         try:
             while True:
                 try:
@@ -655,6 +660,10 @@ class PhysicalPlan:
                     rec = query_metrics_entry(ctx, "Recovery")
                     rec.add("retriesAttempted", 1)
                     attempt += 1
+        except BaseException as e:
+            status = "error"
+            err_text = f"{type(e).__name__}: {e}"
+            raise
         finally:
             if ticket is not None:
                 # Teardown accounting BEFORE the context close captures
@@ -681,6 +690,24 @@ class PhysicalPlan:
                 # BEFORE the context close: sessions opened on it are
                 # keep_on_close, so the coordinator owns this cleanup.
                 qrun.finish()
+            # Live telemetry + persistent event log, BEFORE the context
+            # close (the record reads ctx.metrics and the trace ring).
+            if ticket is not None and ticket.token.cancelled():
+                status = ("deadline"
+                          if ticket.token.reason == "deadline exceeded"
+                          else "cancelled")
+            qos_class = ticket.qos_class if ticket is not None else None
+            q_tenant = ticket.tenant if ticket is not None else None
+            dur_ms = (_time.perf_counter() - t0_query) * 1e3
+            lbls = {"class": str(qos_class or "-"),
+                    "tenant": str(q_tenant or "-")}
+            monitoring.telemetry.inc("srt_queries", status=status, **lbls)
+            monitoring.telemetry.observe("srt_query_latency_ms", dur_ms,
+                                         **lbls)
+            monitoring.history.log_query(
+                self, ctx, query_id=trace_qid, status=status,
+                qos_class=qos_class, tenant=q_tenant,
+                duration_ms=dur_ms, error=err_text)
             # Metrics survive the collect for DataFrame.metrics().
             self.last_ctx = ctx
             if owned:

@@ -127,6 +127,20 @@ def _trace_ring_isolation():
 
 
 @pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    """Clear the live-telemetry registry and event-log routing after
+    every test: a metrics-enabled test must never leak counter values,
+    fleet payloads, or a configured event-log directory into a later
+    test's scrape/record assertions. The enabled flag itself is left
+    as-is so an env-armed SRT_METRICS=1 matrix run (whole-suite
+    acceptance) keeps recording test to test — only the values clear."""
+    yield
+    from spark_rapids_tpu.monitoring import history, telemetry
+    telemetry.reset()
+    history.set_dir("")
+
+
+@pytest.fixture(autouse=True)
 def _cost_calibration_isolation():
     """Reset the cost model's self-calibration state after every test: a
     traced collect feeds observed sync/throughput numbers into

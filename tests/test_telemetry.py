@@ -1,0 +1,312 @@
+"""Live telemetry plane (ISSUE 15): metric registry, OpenMetrics
+exposition, scrape-able rejection telemetry, the persistent query event
+log, and the post-hoc history CLI.
+
+Contract under test:
+- histogram quantiles reconstruct within the log-bucket error bound,
+  and window rotation ages observations out of the quantile view while
+  the lifetime ``_count``/``_sum`` pair stays monotonic;
+- ``render_text`` emits OpenMetrics: ``# TYPE`` lines, escaped label
+  values, counters with a ``_total`` sample suffix, ``# EOF``;
+- metrics off (the default) records nothing and the recording API is a
+  no-op;
+- two concurrent tenant-tagged queries land in separate labeled series;
+- a saturated admission queue produces a nonzero
+  ``srt_queries_rejected_total{kind="queue-full"}`` scrape line and a
+  structured QueryRejectedError;
+- the localhost exporter serves ``/metrics`` over real HTTP;
+- event-log records round-trip through ``scripts/history.py`` in a
+  FRESH process (the history-server property), and a chaos run's
+  recovery instants land in the record bit-identically to the flight
+  recorder's ring.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from spark_rapids_tpu import faults, monitoring
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.monitoring import exporter, history, telemetry
+from spark_rapids_tpu.parallel import scheduler as SC
+from spark_rapids_tpu.parallel.scheduler import QueryRejectedError
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_telemetry"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=7)
+    return d
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.configure("")
+    faults.reset_counters()
+    telemetry.configure(False)
+    telemetry.reset()
+    yield
+    telemetry.configure(False)
+    telemetry.reset()
+    monitoring.configure(False)
+    monitoring.reset()
+    exporter.stop()
+
+
+def _session(**over):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.metrics.enabled", True)
+    for k, v in over.items():
+        s.set(k, v)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Registry unit surface: histograms, exposition, kinds, no-op
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_within_bucket_error():
+    telemetry.configure(True)
+    for v in range(1, 1001):        # uniform 1..1000
+        telemetry.observe("srt_t_lat_ms", float(v))
+    snap = telemetry.snapshot()["metrics"]["srt_t_lat_ms"]["series"][0]
+    assert snap["count"] == 1000
+    assert snap["sum"] == pytest.approx(500500.0)
+    # Log buckets grow ~19% per step: a reconstructed quantile lands
+    # within ~one bucket of the true value.
+    assert snap["p50"] == pytest.approx(500.0, rel=0.20)
+    assert snap["p99"] == pytest.approx(990.0, rel=0.20)
+    # Non-positive observations clamp to the zero bucket, not a crash.
+    telemetry.observe("srt_t_zero_ms", 0.0)
+    z = telemetry.snapshot()["metrics"]["srt_t_zero_ms"]["series"][0]
+    assert z["p50"] == 0.0 and z["count"] == 1
+
+
+def test_histogram_window_rotation_ages_out_quantiles():
+    telemetry.configure(True)
+    for _ in range(100):
+        telemetry.observe("srt_t_rot_ms", 1000.0)
+    # Push the 1000ms epoch past the window (current + 7 retained).
+    for _ in range(8):
+        telemetry.rotate_windows()
+    for _ in range(3):
+        telemetry.observe("srt_t_rot_ms", 10.0)
+    s = telemetry.snapshot()["metrics"]["srt_t_rot_ms"]["series"][0]
+    # Quantiles see only the live window; lifetime count/sum keep all.
+    assert s["p50"] == pytest.approx(10.0, rel=0.25)
+    assert s["p99"] == pytest.approx(10.0, rel=0.25)
+    assert s["count"] == 103
+    assert s["sum"] == pytest.approx(100030.0)
+
+
+def test_openmetrics_rendering_golden():
+    telemetry.configure(True)
+    telemetry.inc("srt_t_requests", tenant='a"b\\c\nd')
+    telemetry.inc("srt_t_requests", amount=2.0, tenant="plain")
+    telemetry.set_gauge("srt_t_depth", 7)
+    telemetry.observe("srt_t_ms", 100.0)
+    text = telemetry.render_text()
+    assert "# TYPE srt_t_requests counter" in text
+    assert "# TYPE srt_t_depth gauge" in text
+    assert "# TYPE srt_t_ms histogram" in text
+    # Counter samples wear the _total suffix; label escaping is the
+    # OpenMetrics triple (backslash, quote, newline).
+    assert 'srt_t_requests_total{tenant="a\\"b\\\\c\\nd"} 1' in text
+    assert 'srt_t_requests_total{tenant="plain"} 2' in text
+    assert "srt_t_depth 7" in text
+    assert 'srt_t_ms{quantile="0.5"}' in text
+    assert "srt_t_ms_sum 100" in text
+    assert "srt_t_ms_count 1" in text
+    assert text.endswith("# EOF\n")
+
+
+def test_metric_kind_is_sticky():
+    telemetry.configure(True)
+    telemetry.inc("srt_t_kind")
+    with pytest.raises(ValueError):
+        telemetry.set_gauge("srt_t_kind", 1.0)
+
+
+def test_metrics_off_records_nothing():
+    assert not telemetry.enabled()
+    telemetry.inc("srt_t_off")
+    telemetry.observe("srt_t_off_ms", 5.0)
+    telemetry.set_gauge("srt_t_off_g", 1.0)
+    assert telemetry.snapshot()["metrics"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Query instrumentation: tenants, rejections, scrape
+# ---------------------------------------------------------------------------
+
+def _series(name):
+    m = telemetry.snapshot()["metrics"].get(name, {"series": []})
+    return {tuple(sorted(s["labels"].items())): s for s in m["series"]}
+
+
+def test_per_tenant_series_isolation_two_concurrent_queries():
+    s = _session()
+    df_a = s.range(0, 20_000)
+    df_b = s.range(0, 30_000)
+    ha = df_a.submit(tenant="tenantA")
+    hb = df_b.submit(tenant="tenantB")
+    assert len(ha.result(120)) == 20_000
+    assert len(hb.result(120)) == 30_000
+    q = _series("srt_queries")
+    key_a = (("class", "-"), ("status", "ok"), ("tenant", "tenantA"))
+    key_b = (("class", "-"), ("status", "ok"), ("tenant", "tenantB"))
+    assert q[key_a]["value"] == 1.0
+    assert q[key_b]["value"] == 1.0
+    lat = _series("srt_query_latency_ms")
+    assert lat[(("class", "-"), ("tenant", "tenantA"))]["count"] == 1
+    assert lat[(("class", "-"), ("tenant", "tenantB"))]["count"] == 1
+
+
+def test_queue_full_rejection_scrape_line():
+    s = _session(**{
+        "spark.rapids.sql.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.sql.scheduler.queueDepth": 0,
+        "spark.rapids.sql.scheduler.admissionTimeoutMs": 200,
+    })
+    df = s.range(0, 1000)
+    mgr = SC.get_query_manager(s.conf)
+    hog = mgr.admit()
+    try:
+        with pytest.raises(QueryRejectedError) as ei:
+            df.collect()
+    finally:
+        mgr.finish(hog)
+    # Structured shed-load fields on the error itself...
+    assert ei.value.kind == "queue-full"
+    assert ei.value.queue_depth is not None
+    # ...and as a labeled scrape series with the kind dimension.
+    text = telemetry.render_text()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("srt_queries_rejected_total")
+                and 'kind="queue-full"' in ln)
+    assert float(line.rsplit(" ", 1)[1]) >= 1
+    assert "# TYPE srt_queries_rejected counter" in text
+    # The rejected query never admitted: it must not count as run.
+    assert not any('status="ok"' in ln and "srt_queries_total" in ln
+                   for ln in text.splitlines())
+
+
+def test_exporter_serves_metrics_over_http():
+    telemetry.configure(True)
+    telemetry.inc("srt_t_http_hits", amount=3.0)
+    port = exporter.ensure_started(0)
+    assert port > 0 and exporter.running()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        body = r.read().decode()
+        ctype = r.headers.get("Content-Type", "")
+    assert "text/plain" in ctype
+    assert "srt_t_http_hits_total 3" in body
+    assert body.endswith("# EOF\n")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+        assert r.status == 200
+    exporter.stop()
+    assert not exporter.running()
+
+
+def test_funnel_sync_reconciles_with_scheduler_counters():
+    s = _session()
+    base = SC.counters().get("admitted", 0)
+    s.range(0, 5000).collect()
+    s.range(0, 5000).collect()
+    q = _series("srt_scheduler_admitted")
+    total = sum(v["value"] for v in q.values())
+    assert total == SC.counters().get("admitted", 0) >= base + 2
+    # Idempotent: a second sync publishes the same absolutes.
+    assert _series("srt_scheduler_admitted") == q
+
+
+# ---------------------------------------------------------------------------
+# Event log + history CLI
+# ---------------------------------------------------------------------------
+
+def _history_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "history.py"),
+         *args],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+
+
+def test_event_log_roundtrip_through_history_cli(tmp_path):
+    log_dir = str(tmp_path / "events")
+    s = _session(**{
+        "spark.rapids.sql.eventLog.dir": log_dir,
+        "spark.rapids.sql.trace.enabled": True,
+    })
+    s.range(0, 10_000).collect(tenant="cliTenant")
+    s.range(0, 4_000).collect()
+    records = history.read_events(log_dir)
+    assert len(records) == 2
+    rec = records[0]
+    assert rec["v"] == history.SCHEMA_VERSION
+    assert rec["status"] == "ok" and rec["tenant"] == "cliTenant"
+    assert rec["nodes"][0]["name"] == "RangeExec"
+    assert rec["categories"]          # trace was on: span breakdown
+    # The CLI reconstructs the reports in a FRESH process, from the log
+    # alone (the writer process's state is irrelevant by then).
+    ls = _history_cli(log_dir)
+    assert ls.returncode == 0, ls.stderr
+    assert ls.stdout.count("query ") == 2
+    assert "tenant=cliTenant" in ls.stdout
+    rep = _history_cli(log_dir, "--query", str(rec["query_id"]))
+    assert rep.returncode == 0, rep.stderr
+    assert "RangeExec" in rep.stdout
+    assert f"query {rec['query_id']} [ok]" in rep.stdout
+    summ = _history_cli(log_dir, "--summary")
+    assert summ.returncode == 0, summ.stderr
+    fleet = json.loads(summ.stdout)
+    assert fleet["queries"] == 2
+    assert fleet["byStatus"] == {"ok": 2}
+    assert fleet["byTenant"].get("cliTenant") == 1
+    assert fleet["p50Ms"] is not None
+
+
+def test_event_log_off_writes_nothing(tmp_path):
+    s = _session()            # metrics on, event log NOT configured
+    s.range(0, 1000).collect()
+    assert history.log_dir() == ""
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_chaos_instants_bit_identical_in_event_log(data_dir, tmp_path):
+    log_dir = str(tmp_path / "events")
+    s = _session(**{
+        "spark.rapids.sql.eventLog.dir": log_dir,
+        "spark.rapids.sql.trace.enabled": True,
+        "spark.rapids.sql.test.faults": "oom@upload:1,transient@download:1",
+        "spark.rapids.sql.test.faults.seed": 7,
+        "spark.rapids.sql.retry.backoffMs": 1,
+        "spark.rapids.sql.format.scanCache.maxBytes": 0,
+    })
+    df = tpch.QUERIES["q3"](s, data_dir)
+    df.collect()
+    qid = df._physical().last_ctx.cache["trace_query"]
+    (rec,) = history.read_events(log_dir)
+    # The record's instants are the ring's instants, verbatim (JSON
+    # round-tripped): recovery forensics survive the process.
+    want = json.loads(json.dumps(
+        [[e[1], e[2], e[3], history._json_safe(e[7])]
+         for e in monitoring.events(qid) if e[0] == "i"]))
+    assert rec["instants"] == want
+    names = {i[0] for i in rec["instants"]}
+    assert "fault-injected" in names
+    kinds = {(i[3] or {}).get("kind") for i in rec["instants"]
+             if i[0] == "fault-injected"}
+    assert {"oom", "transient"} <= kinds
+    assert rec["status"] == "ok"      # ladder recovered; record agrees
