@@ -573,9 +573,13 @@ def _distributed_probe(tpch_dir: str) -> dict:
     placement only settles once every worker has compiled its stages —
     up to one compile wave per worker, hence n+1 warm runs."""
     import subprocess
+    import tempfile
 
     from spark_rapids_tpu.benchmarks import tpch
     from spark_rapids_tpu.parallel import cluster as CL
+    from spark_rapids_tpu.parallel import transport as _tp
+
+    jdir = tempfile.mkdtemp(prefix="srt_bench_cluster_")
 
     def q3_session(n=None):
         s = _session()
@@ -583,14 +587,24 @@ def _distributed_probe(tpch_dir: str) -> dict:
         if n is not None:
             s.set("spark.rapids.sql.cluster.enabled", True)
             s.set("spark.rapids.sql.cluster.minWorkers", n)
+            # Journal the 3-worker round so the replay path below
+            # measures a real WAL, not an empty file.
+            if n == 3:
+                s.set("spark.rapids.sql.cluster.dir", jdir)
+                s.set("spark.rapids.sql.cluster.journal.enabled", True)
         return s
 
     want = tpch.QUERIES["q3"](q3_session(), tpch_dir).collect()
     root = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.pop("SRT_FAULTS", None)
+    bc0 = dict(_tp.counters())
     res: dict = {"query": "q3", "shuffle_forced": True,
-                 "host_cpus": os.cpu_count()}
+                 "host_cpus": os.cpu_count(),
+                 # Which data plane stage outputs publish through
+                 # (ISSUE 17: hostfile spool vs object store).
+                 "store_kind": CL.cluster_store_kind(
+                     q3_session(1).conf)}
     for n in (1, 2, 3):
         sc = q3_session(n)
         co = CL.get_coordinator(sc.conf)
@@ -625,6 +639,25 @@ def _distributed_probe(tpch_dir: str) -> dict:
     w3 = res.get("workers_3", {}).get("seconds")
     if w1 and w3:
         res["speedup_3v1"] = round(w1 / w3, 3)
+    # Coordinator failover cost: replay the 3-worker round's journal
+    # into a fresh coordinator, exactly what a SIGKILL + restart pays
+    # before it starts listening (parallel/cluster/journal.py).
+    try:
+        from spark_rapids_tpu import config as _C
+        co2 = CL.ClusterCoordinator(_C.TpuConf({
+            "spark.rapids.sql.cluster.dir": jdir,
+            "spark.rapids.sql.cluster.journal.enabled": True}))
+        res["journal_replay_ms"] = round(co2.journal_replay_ms, 3)
+        co2.close()
+    except Exception as e:      # pragma: no cover - probe must not die
+        res["journal_replay_error"] = f"{type(e).__name__}: {e}"
+    # Broadcast artifact cache traffic across the probe (zero under the
+    # shuffle-forced q3 — broadcast-join queries populate it).
+    bc1 = _tp.counters()
+    res["broadcast_cache"] = {
+        k: bc1.get(k, 0) - bc0.get(k, 0)
+        for k in ("broadcastCacheHits", "broadcastCacheMisses",
+                  "broadcastCachePublishes")}
     return res
 
 

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Standalone localhost object-store stub for the objectstore shuffle
+transport (parallel/transport/objectstore.py).
+
+Serves PUT/GET/DELETE /o/<key>, GET /list?prefix=, GET /health, and an
+admin surface for chaos steering:
+
+    POST /admin/latency?ms=N          inject per-request latency
+    POST /admin/fail?n=N[&code=503]   fail the next N data-plane requests
+    POST /admin/drop?prefix=K         delete keys (exact key or prefix)
+    POST /admin/reset                 clear objects + injections
+    GET  /admin/stats                 counters as JSON
+
+Usage::
+
+    python scripts/objstore_stub.py [--host 127.0.0.1] [--port 9000]
+    SRT_OBJECTSTORE_ENDPOINT=http://127.0.0.1:9000 \
+        SRT_SHUFFLE_TRANSPORT=objectstore python -m pytest tests/ ...
+
+With no --port, an OS-assigned port is used and printed. The stub is
+in-memory: killing it loses every object (which is the point — the
+chaos matrix kills it).
+"""
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 = OS-assigned (printed on stdout)")
+    args = ap.parse_args(argv)
+
+    from spark_rapids_tpu.parallel.transport.objectstore import \
+        ObjectStoreStub
+    stub = ObjectStoreStub(host=args.host, port=args.port)
+    print(f"objstore stub listening at {stub.endpoint}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stub.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

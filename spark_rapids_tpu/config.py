@@ -850,6 +850,75 @@ SHUFFLE_TRANSPORT_HOSTFILE_RV_BACKOFF_MS = conf(
     "Base backoff between rendezvous round-trip retries; attempt i "
     "sleeps backoffMs * 2^i (deterministic, capped at 2s).").integer(50)
 
+SHUFFLE_TRANSPORT_OBJECTSTORE_ENDPOINT = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.endpoint").doc(
+    "Base URL of the object-store backend for the objectstore shuffle "
+    "transport (parallel/transport/objectstore.py), e.g. "
+    "'http://127.0.0.1:9000'. Empty = SRT_OBJECTSTORE_ENDPOINT, else an "
+    "in-process localhost stub server is started once per process "
+    "(single-machine stand-in for S3/GCS; the cluster coordinator pins "
+    "the resolved endpoint into dispatched worker confs so every "
+    "process shares one store).").string("")
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_PREFIX = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.prefix").doc(
+    "Key-namespace prefix prepended to every object this session "
+    "reads or writes ('<prefix>/<tag>/<worker>/pNNNNN-SSSS.shard'). "
+    "The cluster runtime sets '<cluster-ns>/q<qid>' per query so "
+    "concurrent queries and clusters can share one store. Empty = "
+    "keys rooted at the tag.").string("")
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_WORKER_ID = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.workerId").doc(
+    "This process's worker identity in the object store (manifest "
+    "name + shard key segment). Empty = 'w<pid>'.").string("")
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_EXPECTED_WORKERS = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.expectedWorkers"
+).doc(
+    "How many worker manifests a reduce-side fetch waits for before "
+    "serving shards (same membership contract as "
+    "hostfile.expectedWorkers). 1 = single-process.").integer(1)
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_EXCLUSIVE_MANIFEST = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.exclusiveManifest"
+).doc(
+    "Single-writer manifest mode: commit publishes ONE tag-scoped "
+    "'exchange.manifest.json' object (a whole-object PUT is the atomic "
+    "publication barrier — readers see the old manifest or the new "
+    "one, never a torn mix), mirroring "
+    "hostfile.exclusiveManifest for the cluster runtime.").boolean(
+    False)
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_FETCH_TIMEOUT_MS = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.fetchTimeoutMs"
+).doc(
+    "How long a reduce-side fetch polls for the expected worker "
+    "manifests before failing with a lost-shard error (which flows "
+    "into the recovery ladder).").integer(30000)
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_RETRIES = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.retries").doc(
+    "Bounded retry count for one backend request (put/get/list/"
+    "delete) on TRANSIENT errors — 5xx responses, refused/reset "
+    "connections, socket timeouts. Attempt i sleeps backoffMs * "
+    "2^(i-1) (capped at 2s) plus a deterministic jitter derived from "
+    "the object key, so a fleet of fetchers retrying the same outage "
+    "does not stampede in lockstep. Exhausted retries raise a typed "
+    "'UNAVAILABLE:' error onto the transient rung of the recovery "
+    "ladder. A 404 on a manifest-listed shard is NOT retried — that "
+    "is shard loss and goes to stage recompute instead.").integer(4)
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_BACKOFF_MS = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.backoffMs").doc(
+    "Base backoff between backend-request retries (see "
+    "objectstore.retries for the schedule).").integer(25)
+
+SHUFFLE_TRANSPORT_OBJECTSTORE_TIMEOUT_MS = conf(
+    "spark.rapids.sql.shuffle.transport.objectstore.timeoutMs").doc(
+    "Socket connect/read timeout for one HTTP request to the object "
+    "store backend.").integer(5000)
+
 CLUSTER_ENABLED = conf("spark.rapids.sql.cluster.enabled").doc(
     "Distributed worker runtime (parallel/cluster/): the driver "
     "partitions each query's stage DAG into stage tasks and dispatches "
@@ -913,6 +982,58 @@ CLUSTER_STEAL_DELAY_MS = conf(
     "stages instead of paying a fresh kernel trace on whichever "
     "process grabbed them first. 0 disables the reservation.").integer(
     200)
+
+CLUSTER_COORDINATOR_REMOTE = conf(
+    "spark.rapids.sql.cluster.coordinator.remote").doc(
+    "Treat cluster.coordinator as an ALREADY-RUNNING standalone "
+    "coordinator process (python -m "
+    "spark_rapids_tpu.parallel.cluster.coordinator) instead of "
+    "hosting one in the driver. The driver submits stage plans over "
+    "the control socket and polls for completion, riding out "
+    "coordinator outages up to dispatchTimeoutMs — combined with the "
+    "journal this is what makes a coordinator SIGKILL + restart "
+    "mid-query survivable. Requires cluster.dir to be a path shared "
+    "with the coordinator and workers.").boolean(False)
+
+CLUSTER_JOURNAL_ENABLED = conf(
+    "spark.rapids.sql.cluster.journal.enabled").doc(
+    "Write-ahead journal for coordinator failover: registration and "
+    "per-query stage state (submit/dispatch/done/requeue, with stage "
+    "generations) are appended as torn-line-tolerant JSONL under "
+    "<cluster.dir>/journal/ — the same event-log machinery as "
+    "monitoring/history.py. A restarted coordinator replays the "
+    "journal, re-adopts stage outputs whose transport manifests are "
+    "still committed, and requeues only the tasks that were in "
+    "flight, bounding a coordinator crash at ≤1 recompute per "
+    "affected stage.").boolean(True)
+
+CLUSTER_JOURNAL_FSYNC = conf(
+    "spark.rapids.sql.cluster.journal.fsync").doc(
+    "fsync the journal after every append. Off by default: the "
+    "failover contract tolerates a torn tail (an unflushed 'done' "
+    "record costs at most the one recompute the crash already "
+    "budgeted), so the default buys dispatch latency instead of "
+    "durability theater.").boolean(False)
+
+BROADCAST_CACHE_ENABLED = conf(
+    "spark.rapids.sql.broadcast.cache.enabled").doc(
+    "Cluster-wide broadcast artifact cache: the first process to "
+    "build a broadcast build-side publishes the built batch through "
+    "the shuffle transport (keyed by plan fingerprint + upstream "
+    "stage generations, same CRC-framed blob + "
+    "manifest-as-publication-barrier + refetch-once contract as "
+    "stage outputs), and every other worker fetches it instead of "
+    "re-collecting and re-building the same table. Only active when "
+    "a query runs under the cluster runtime; any cache miss or "
+    "corruption falls back to the local build, never to a query "
+    "error.").boolean(True)
+
+BROADCAST_CACHE_FETCH_TIMEOUT_MS = conf(
+    "spark.rapids.sql.broadcast.cache.fetchTimeoutMs").doc(
+    "How long a broadcast-cache probe waits for a published manifest "
+    "before declaring a miss and building locally. Deliberately "
+    "short — the cache is an optimization, and the local build is "
+    "always correct.").integer(50)
 
 NATIVE_ENABLED = conf("spark.rapids.sql.native.enabled").doc(
     "Native Pallas kernel layer (ops/native.py): re-implement the "

@@ -28,7 +28,13 @@ env)::
   (SIGKILLs the cluster worker process at the ``cluster.stage`` site,
   parallel/cluster/worker.py — the coordinator's heartbeat monitor
   detects the death and requeues the stage task on a survivor: one
-  stage recompute, never a dead query).
+  stage recompute, never a dead query), ``slowput`` (injects latency
+  into a shuffle-transport shard write at the ``transport`` site —
+  exercises slow-writer overlap, never an error), or ``unavailable``
+  (one backend request at the ``objectstore`` site fails with a
+  synthetic 5xx/UNAVAILABLE; absorbed by the transport's bounded
+  retry with exponential backoff + deterministic jitter, counter
+  ``objectstoreRetries``).
 - ``site``: a named injection point woven into the dispatch funnels:
   ``upload`` (wire codec device_put), ``download`` (result device_get),
   ``concat`` (batch coalescing), ``kernel`` (cached-kernel dispatch),
@@ -40,7 +46,9 @@ env)::
   write funnels, parallel/transport/ — ``lostshard`` deletes the shard
   at rest and raises owner-tagged, so recovery MUST recompute the
   owning stage; ``corrupt`` flips a byte of the fetched frame, detected
-  by the CRC and refetched once, counter ``remoteShardRefetches``),
+  by the CRC and refetched once, counter ``remoteShardRefetches``;
+  ``slowput`` delays the shard write), ``objectstore`` (one HTTP
+  request to the object-store backend — ``unavailable`` only),
   ``spill.write`` / ``spill.read`` (disk tier I/O), ``wire``
   (serialized spill frames — corrupt only), ``cluster.stage``
   (cluster worker stage-task execution — workerdeath only).
@@ -242,7 +250,7 @@ class FaultSpec:
 
 
 _KINDS = ("oom", "transient", "corrupt", "lostoutput", "stall",
-          "lostshard", "workerdeath")
+          "lostshard", "workerdeath", "slowput", "unavailable")
 
 
 class FaultParseError(ValueError):

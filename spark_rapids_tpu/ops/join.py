@@ -914,6 +914,11 @@ class BroadcastHashJoinExec(ShuffledHashJoinExec):
         built = ctx.cache.get(cache_key)
         if built is None:
             bbatches = []
+            # In cluster mode the broadcast child may ADOPT its single
+            # from the transport-backed broadcast artifact cache
+            # (parallel/broadcast_cache.py) instead of re-collecting —
+            # this loop is the consumer of that hit; only the
+            # fingerprint sort below is always process-local.
             for cp in range(build_child.num_partitions(ctx)):
                 bbatches.extend(build_child.execute_device(ctx, cp))
             if bbatches:
@@ -923,6 +928,7 @@ class BroadcastHashJoinExec(ShuffledHashJoinExec):
             else:
                 built = "EMPTY"
             ctx.cache[cache_key] = built
+            ctx.metrics_for(self).add("buildSideBuilds", 1)
         if built == "EMPTY":
             for pbatch in probe_iter:
                 if self.join_type == "anti":

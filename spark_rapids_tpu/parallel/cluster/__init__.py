@@ -19,9 +19,21 @@ package are a ``ctx.cache["cluster"]`` lookup in the exchange's session
 opener/materializer and the prepare/recompute/reset calls in the
 planner's recovery ladder, all of which no-op when the marker is
 absent.
+
+Survivability (ISSUE 17): the coordinator write-ahead-journals its
+scheduling state (:mod:`journal`) and can run as a STANDALONE process
+(``python -m ...cluster.coordinator``) that survives SIGKILL by
+replaying the journal on restart; drivers opt into the out-of-process
+coordinator with ``cluster.coordinator.remote=true`` (:mod:`remote`)
+and ride out the restart window instead of failing; workers reconnect
+with capped backoff instead of dying on a refused poll.
 """
 
 from spark_rapids_tpu.parallel.cluster.coordinator import (   # noqa: F401
     ClusterCoordinator, ClusterDispatchError, ClusterExecInfo, QueryRun,
-    cluster_enabled, get_coordinator, maybe_prepare,
-    shutdown_coordinator, stage_plan)
+    cluster_enabled, cluster_store_kind, get_coordinator, maybe_prepare,
+    merge_worker_reports, shutdown_coordinator, stage_plan)
+from spark_rapids_tpu.parallel.cluster.journal import (       # noqa: F401
+    Journal, replay_state)
+from spark_rapids_tpu.parallel.cluster.remote import (        # noqa: F401
+    RemoteQueryRun, remote_prepare)

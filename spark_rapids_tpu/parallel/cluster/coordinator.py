@@ -125,23 +125,54 @@ def _hrw_owner(wids: List[str], sid: int) -> Optional[str]:
         f"{w}|{sid}".encode()).digest())
 
 
+def cluster_store_kind(conf) -> str:
+    """Which transport cluster stage outputs publish through: the
+    objectstore when the session runs on it, the hostfile spool for
+    everything else (inprocess/hostfile — the shared directory is the
+    DCN stand-in either way)."""
+    from spark_rapids_tpu.parallel import transport as T
+    return "objectstore" if T.transport_name(conf) == "objectstore" \
+        else "hostfile"
+
+
 class ClusterExecInfo:
     """Per-process cluster execution marker, parked at
     ``ctx.cache["cluster"]``: maps each dispatchable boundary exchange
     (by its in-process identity) to its cross-process stage tag and
-    builds the exclusive-manifest hostfile sessions the exchange layer
-    opens instead of its default transport. ``local_sid`` is the stage
-    THIS process is currently producing (None on the driver): its
-    boundary gets a write session; every other tagged exchange gets a
-    fetch-only session that adopts the committed manifest."""
+    builds the exclusive-manifest transport sessions the exchange layer
+    opens instead of its default transport — hostfile on the shared
+    spool, or the objectstore under the query's key prefix when the
+    session runs on that transport. ``local_sid`` is the stage THIS
+    process is currently producing (None on the driver): its boundary
+    gets a write session; every other tagged exchange gets a fetch-only
+    session that adopts the committed manifest.
+
+    Broadcast artifact cache (ISSUE 17 tentpole leg c): ``bcast_tags``
+    maps each broadcast-boundary exchange to its stage id and
+    ``broadcast_tag`` derives its cluster-wide cache key — plan
+    fingerprint + the GENERATIONS of its dispatchable upstream stages,
+    so a recomputed input invalidates the key and a stale cached build
+    can never be adopted."""
 
     def __init__(self, spool_dir: str, worker_id: str,
                  tags: Dict[int, Tuple[int, str]],
-                 local_sid: Optional[int] = None):
+                 local_sid: Optional[int] = None,
+                 store_kind: str = "hostfile",
+                 store_endpoint: str = "", store_prefix: str = "",
+                 bcast_tags: Optional[Dict[int, int]] = None,
+                 bcast_deps: Optional[Dict[int, List[int]]] = None,
+                 plan_fp: str = "", gen_source=None):
         self.spool_dir = spool_dir
         self.worker_id = worker_id
         self.tags = tags                  # id(exchange) -> (sid, tag)
         self.local_sid = local_sid
+        self.store_kind = store_kind
+        self.store_endpoint = store_endpoint
+        self.store_prefix = store_prefix
+        self.bcast_tags = bcast_tags or {}    # id(exchange) -> sid
+        self.bcast_deps = bcast_deps or {}    # sid -> dispatchable deps
+        self.plan_fp = plan_fp
+        self.gen_source = gen_source          # callable -> {sid: gen}
 
     def set_local(self, sid: Optional[int]) -> None:
         self.local_sid = sid
@@ -154,31 +185,83 @@ class ClusterExecInfo:
         ent = self.tags.get(id(exchange))
         return ent is not None and ent[0] != self.local_sid
 
+    def open_session(self, ctx, tag: str, num_partitions: int,
+                     owner: Optional[int] = None,
+                     fetch_timeout_ms: Optional[int] = None):
+        """One exclusive-manifest cluster session on the query's store
+        (hostfile spool or objectstore prefix); keep_on_close because
+        the COORDINATOR owns query-end store cleanup, not any one
+        context's teardown."""
+        from spark_rapids_tpu.parallel import transport as T
+        raw = dict(ctx.conf.raw)
+        if self.store_kind == "objectstore":
+            from spark_rapids_tpu.parallel.transport.objectstore import \
+                ObjectStoreTransport
+            raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_ENDPOINT.key] = \
+                self.store_endpoint
+            raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_PREFIX.key] = \
+                self.store_prefix
+            raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_WORKER_ID.key] = \
+                self.worker_id
+            raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_EXCLUSIVE_MANIFEST.key] \
+                = True
+            if fetch_timeout_ms is not None:
+                raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_FETCH_TIMEOUT_MS
+                    .key] = int(fetch_timeout_ms)
+            sess = ObjectStoreTransport().open(
+                C.TpuConf(raw), tag, num_partitions, owner=owner,
+                catalog=ctx.catalog, metrics=T.metrics_entry(ctx))
+        else:
+            from spark_rapids_tpu.parallel.transport.hostfile import \
+                HostFileTransport
+            raw[C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key] = self.spool_dir
+            raw[C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID.key] = \
+                self.worker_id
+            raw[C.SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST.key] = \
+                True
+            raw[C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS.key] = ""
+            if fetch_timeout_ms is not None:
+                raw[C.SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS.key] \
+                    = int(fetch_timeout_ms)
+            sess = HostFileTransport().open(
+                C.TpuConf(raw), tag, num_partitions, owner=owner,
+                catalog=ctx.catalog, metrics=T.metrics_entry(ctx))
+        sess.keep_on_close = True
+        return sess
+
     def session_for(self, ctx, exchange):
         """The cluster transport session for a tagged exchange, or None
         (untagged — the exchange opens its configured transport as
-        before). Always hostfile + exclusive manifest on the query's
-        spool; keep_on_close because the COORDINATOR owns query-end
-        spool removal, not any one context's teardown."""
+        before)."""
         ent = self.tags.get(id(exchange))
         if ent is None:
             return None
         sid, tag = ent
-        from spark_rapids_tpu.parallel import transport as T
-        from spark_rapids_tpu.parallel.transport.hostfile import \
-            HostFileTransport
-        raw = dict(ctx.conf.raw)
-        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_DIR.key] = self.spool_dir
-        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_WORKER_ID.key] = self.worker_id
-        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_EXCLUSIVE_MANIFEST.key] = True
-        raw[C.SHUFFLE_TRANSPORT_HOSTFILE_RENDEZVOUS.key] = ""
-        sess = HostFileTransport().open(
-            C.TpuConf(raw), tag, exchange.partitioning.num_partitions,
-            owner=id(exchange), catalog=ctx.catalog,
-            metrics=T.metrics_entry(ctx))
-        sess.keep_on_close = True
+        sess = self.open_session(
+            ctx, tag, exchange.partitioning.num_partitions,
+            owner=id(exchange))
         sess.fetch_only = sid != self.local_sid
         return sess
+
+    def broadcast_tag(self, exchange) -> Optional[str]:
+        """The broadcast artifact cache key for a broadcast-boundary
+        exchange, or None (not a tagged broadcast stage / no plan
+        fingerprint). Generation-keyed: a recomputed upstream shuffle
+        stage changes the key, so a cached build of stale inputs is
+        simply never found — the gen sum is defense-in-depth on top of
+        bit-identical recomputes."""
+        sid = self.bcast_tags.get(id(exchange))
+        if sid is None or not self.plan_fp:
+            return None
+        gens: Dict[int, int] = {}
+        if callable(self.gen_source):
+            try:
+                gens = self.gen_source() or {}
+            except Exception:
+                gens = {}
+        gsum = sum(int(gens.get(d, 0))
+                   for d in self.bcast_deps.get(sid, ()))
+        return f"bc-{self.plan_fp}-s{sid}-g{gsum}"
 
     @staticmethod
     def adopt_manifest(sess, num_partitions: int) -> List[int]:
@@ -195,6 +278,73 @@ class ClusterExecInfo:
                     rows[p] += int(e.get("rows") or 0)
                     sess.record_shard_bytes(p, int(e.get("bytes") or 0))
         return rows
+
+
+def merge_worker_reports(ctx, root, reports: Dict[str, dict]) -> None:
+    """Fold the workers' CDONE stats blobs into the driver's view:
+    per-node observed rows/bytes/wall land in ``ctx.metrics`` under
+    the driver's own operator instances (matched by the shared DFS
+    preorder index — both processes unpickled the same plan, so the
+    walk agrees), and each worker's shipped trace ring is stashed in
+    ``ctx.cache`` for the merged Perfetto export. The driver's own
+    observations always win; among workers, the report that saw the
+    most rows for a node wins (the producer saw the full output, a
+    stage that merely fetched it saw a fetch-side partial). Shared by
+    the in-process :class:`QueryRun` and the remote-coordinator client
+    (parallel/cluster/remote.py)."""
+    if not reports:
+        return
+    from spark_rapids_tpu.ops.base import Metrics
+    ops: List = []
+
+    def walk(op):
+        ops.append(op)
+        for c in op.children:
+            walk(c)
+
+    walk(root)
+    filled: Dict[str, float] = {}   # key -> best worker row count
+    events: Dict[str, tuple] = {}
+    for wid in sorted(reports):
+        rep = reports[wid]
+        for n in rep.get("nodes") or []:
+            i = n.get("idx")
+            if not isinstance(i, int) or i >= len(ops):
+                continue
+            op = ops[i]
+            if op.name != n.get("name"):
+                continue    # plan-shape mismatch: refuse to mislabel
+            vals: Dict[str, float] = {}
+            if n.get("rows") is not None:
+                vals["numOutputRows"] = float(n["rows"])
+            if n.get("bytes") is not None:
+                vals["numOutputBytes"] = float(n["bytes"])
+            if n.get("batches"):
+                vals["numOutputBatches"] = float(n["batches"])
+            if n.get("wall_ms"):
+                vals["totalTime"] = float(n["wall_ms"]) * 1e6
+            if not vals:
+                continue
+            key = f"{op.name}@{id(op):x}"
+            m = ctx.metrics.get(key)
+            if m is not None and key not in filled:
+                continue    # the driver observed this node itself
+            score = vals.get("numOutputRows",
+                             vals.get("totalTime", 0.0) / 1e9)
+            if key in filled and filled[key] >= score:
+                continue
+            filled[key] = score
+            m = ctx.metrics.setdefault(key, Metrics(owner=op.name))
+            with m._lock:
+                m.values.clear()
+                m.values.update(vals)
+        if rep.get("events"):
+            threads = {int(k): v for k, v in
+                       (rep.get("threads") or {}).items()}
+            events[wid] = (rep["events"], threads,
+                           rep.get("tag") or f"worker {wid}")
+    if events:
+        ctx.cache["cluster_worker_events"] = events
 
 
 class _StageTask:
@@ -250,6 +400,22 @@ class QueryRun:
         self._root = None       # driver's unpickled plan root (submit)
         self._trace_qid = 0
         self.finished = False
+        # Stage-output store: hostfile spool (default) or objectstore
+        # (kind, endpoint, key prefix) — set by submit()/replay.
+        self.store_kind = "hostfile"
+        self.store_endpoint = ""
+        self.store_prefix = ""
+        self.plan_fp = ""                 # sha256 of the plan pickle
+        self._bcast_tags: Dict[int, int] = {}
+        self._bcast_deps: Dict[int, List[int]] = {}
+        # Counted recomputes (requeues that bumped stageRecomputes):
+        # surfaced through CWAIT so a REMOTE driver can mirror them
+        # into its own fault counters.
+        self.recomputes = 0
+        # Remote submissions write the plan pickle AFTER the qid comes
+        # back; submit_remote clears this and dispatch holds until the
+        # file lands (checked once in _pick_locked).
+        self._pkl_ready = True
         # Latest per-worker CDONE stats blob (node stats + trace ring).
         # Each report is cumulative for this query on that worker, so
         # last-writer-wins per wid is the correct merge discipline.
@@ -274,7 +440,13 @@ class QueryRun:
         self._trace_qid = ctx.cache.get("trace_query", 0)
         ctx.cache["cluster"] = ClusterExecInfo(
             self.qdir, f"drv{os.getpid()}", self._driver_tags,
-            local_sid=None)
+            local_sid=None, store_kind=self.store_kind,
+            store_endpoint=self.store_endpoint,
+            store_prefix=self.store_prefix,
+            bcast_tags=self._bcast_tags, bcast_deps=self._bcast_deps,
+            plan_fp=self.plan_fp,
+            gen_source=lambda: {sid: t.gen
+                                for sid, t in self.tasks.items()})
 
     def _metrics(self):
         from spark_rapids_tpu.ops.base import query_metrics_entry
@@ -320,73 +492,12 @@ class QueryRun:
         self._merge_worker_reports()
 
     def _merge_worker_reports(self) -> None:
-        """Fold the workers' CDONE stats blobs into the driver's view:
-        per-node observed rows/bytes/wall land in ``ctx.metrics`` under
-        the driver's own operator instances (matched by the shared DFS
-        preorder index — both processes unpickled the same plan, so the
-        walk agrees), and each worker's shipped trace ring is stashed in
-        ``ctx.cache`` for the merged Perfetto export. The driver's own
-        observations always win; among workers, the report that saw the
-        most rows for a node wins (the producer saw the full output, a
-        stage that merely fetched it saw a fetch-side partial)."""
         ctx, root = self._ctx, self._root
         if ctx is None or root is None:
             return
         with self.co._lock:
             reports = dict(self.worker_reports)
-        if not reports:
-            return
-        from spark_rapids_tpu.ops.base import Metrics
-        ops: List = []
-
-        def walk(op):
-            ops.append(op)
-            for c in op.children:
-                walk(c)
-
-        walk(root)
-        filled: Dict[str, float] = {}   # key -> best worker row count
-        events: Dict[str, tuple] = {}
-        for wid in sorted(reports):
-            rep = reports[wid]
-            for n in rep.get("nodes") or []:
-                i = n.get("idx")
-                if not isinstance(i, int) or i >= len(ops):
-                    continue
-                op = ops[i]
-                if op.name != n.get("name"):
-                    continue    # plan-shape mismatch: refuse to mislabel
-                vals: Dict[str, float] = {}
-                if n.get("rows") is not None:
-                    vals["numOutputRows"] = float(n["rows"])
-                if n.get("bytes") is not None:
-                    vals["numOutputBytes"] = float(n["bytes"])
-                if n.get("batches"):
-                    vals["numOutputBatches"] = float(n["batches"])
-                if n.get("wall_ms"):
-                    vals["totalTime"] = float(n["wall_ms"]) * 1e6
-                if not vals:
-                    continue
-                key = f"{op.name}@{id(op):x}"
-                m = ctx.metrics.get(key)
-                if m is not None and key not in filled:
-                    continue    # the driver observed this node itself
-                score = vals.get("numOutputRows",
-                                 vals.get("totalTime", 0.0) / 1e9)
-                if key in filled and filled[key] >= score:
-                    continue
-                filled[key] = score
-                m = ctx.metrics.setdefault(key, Metrics(owner=op.name))
-                with m._lock:
-                    m.values.clear()
-                    m.values.update(vals)
-            if rep.get("events"):
-                threads = {int(k): v for k, v in
-                           (rep.get("threads") or {}).items()}
-                events[wid] = (rep["events"], threads,
-                               rep.get("tag") or f"worker {wid}")
-        if events:
-            ctx.cache["cluster_worker_events"] = events
+        merge_worker_reports(ctx, root, reports)
 
     def _progress(self) -> str:
         by = {}
@@ -408,7 +519,7 @@ class QueryRun:
 
     def reset(self) -> None:
         """Planner rung-3 hook (fresh-context retry): every stage task
-        redispatches from a clean spool."""
+        redispatches from a clean store."""
         with self.co._lock:
             for t in self.tasks.values():
                 t.gen += 1
@@ -417,19 +528,57 @@ class QueryRun:
                 t.ready_ts = None
             shutil.rmtree(self.qdir, ignore_errors=True)
             os.makedirs(self.qdir, exist_ok=True)
-            self.co._write_plan(self)
+            if getattr(self, "_blob", None) is not None:
+                self.co._write_plan(self)
+        if self.store_kind == "objectstore" and self.store_prefix:
+            self.co._objectstore_delete(self.store_endpoint,
+                                        self.store_prefix + "/")
+        self.co._jlog({"t": "reset", "qid": self.qid})
 
     def finish(self) -> None:
         """Query end (success or failure): retire the run and remove
-        the query's spool tree — the coordinator owns this cleanup, so
-        worker/driver context teardowns never race each other over
-        live shard files (their sessions are keep_on_close)."""
+        the query's store state (spool tree and/or objectstore prefix)
+        — the coordinator owns this cleanup, so worker/driver context
+        teardowns never race each other over live shard files (their
+        sessions are keep_on_close)."""
         with self.co._lock:
             self.finished = True
             self.co.queries.pop(self.qid, None)
+            none_active = not self.co.queries
+            wids = self.co._alive_wids_locked()
         shutil.rmtree(self.qdir, ignore_errors=True)
+        if not self.pkl_path.startswith(self.qdir + os.sep):
+            try:                 # remote submissions park the plan
+                os.remove(self.pkl_path)    # under <dir>/plans/
+            except OSError:
+                pass
+        if self.store_kind == "objectstore" and self.store_prefix:
+            self.co._objectstore_delete(self.store_endpoint,
+                                        self.store_prefix + "/")
+        self.co._jlog({"t": "finish", "qid": self.qid})
+        if none_active and self.co.journal is not None:
+            # Compaction: with no in-flight query, only the live
+            # membership (plus the replay audit trail — the evidence
+            # that past restarts recovered) is worth keeping —
+            # atomically shrink the journal instead of growing it
+            # forever.
+            replays = [r for r in self.co.journal.records()
+                       if r.get("t") == "replay"]
+            self.co.journal.rewrite(
+                replays[-8:] +
+                [{"t": "reg", "wid": w, "ts": time.time()}
+                 for w in wids])
 
     # -- coordinator side (lock held) ----------------------------------------
+    def _clear_stage_store_locked(self, sid: int) -> None:
+        """Drop one stage's durable output ahead of its recompute (the
+        rewritten generation must never merge with partial leftovers)."""
+        shutil.rmtree(os.path.join(self.qdir, f"s{sid}"),
+                      ignore_errors=True)
+        if self.store_kind == "objectstore" and self.store_prefix:
+            self.co._objectstore_delete(
+                self.store_endpoint, f"{self.store_prefix}/s{sid}/")
+
     def _requeue_locked(self, t: _StageTask, why: str,
                         count_recompute: bool = True) -> None:
         from spark_rapids_tpu import faults, monitoring
@@ -438,8 +587,12 @@ class QueryRun:
         t.worker = None
         t.ready_ts = None
         t.retries += 1
-        shutil.rmtree(os.path.join(self.qdir, f"s{t.sid}"),
-                      ignore_errors=True)
+        self._clear_stage_store_locked(t.sid)
+        if count_recompute:
+            self.recomputes += 1
+        self.co._jlog({"t": "requeue", "qid": self.qid, "sid": t.sid,
+                       "gen": t.gen, "retries": t.retries,
+                       "counted": count_recompute, "why": why})
         if t.retries > self.max_retries:
             self.error = ClusterDispatchError(
                 f"stage task s{t.sid} of query {self.qid} exhausted its "
@@ -496,6 +649,13 @@ class QueryRun:
         making hot-path placement deterministic."""
         if self.error is not None or self.finished:
             return None
+        if not self._pkl_ready:
+            # Remote submission: the driver writes the plan pickle just
+            # after CSUB returns — hold dispatch until it lands so a
+            # fast-polling worker never opens a missing file.
+            if not os.path.exists(self.pkl_path):
+                return None
+            self._pkl_ready = True
         alive = self.co._alive_wids_locked()
         if len(alive) < self.min_workers:
             return None
@@ -533,6 +693,8 @@ class QueryRun:
                            for d in sorted(best.deps)) or "-"
         line = (f"CTASK {self.qid} {best.sid} {best.gen} {depgens} "
                 f"{base64.b64encode(self.pkl_path.encode()).decode()}\n")
+        self.co._jlog({"t": "dispatch", "qid": self.qid,
+                       "sid": best.sid, "gen": best.gen, "wid": wid})
         return line, best
 
     def _on_done_locked(self, wid: str, sid: int, gen: int,
@@ -546,6 +708,8 @@ class QueryRun:
         t.status = _DONE
         t.bytes = nbytes
         t.producer = wid
+        self.co._jlog({"t": "done", "qid": self.qid, "sid": sid,
+                       "gen": gen, "wid": wid, "bytes": nbytes})
         w = self.co.workers.get(wid)
         if w is not None:
             w.completed += 1
@@ -597,8 +761,27 @@ class ClusterCoordinator:
             os.path.join(tempfile.gettempdir(),
                          f"srt_cluster_{os.getpid()}")
         os.makedirs(self.base_dir, exist_ok=True)
+        # Store namespace for objectstore-backed queries: distinct
+        # clusters sharing one store stay out of each other's keys.
+        self.ns = os.path.basename(os.path.normpath(self.base_dir))
         self.hb_timeout_ms = max(
             int(conf.get(C.CLUSTER_HEARTBEAT_TIMEOUT_MS)), 1)
+        self._backends: Dict[str, object] = {}
+        # Write-ahead journal + replay BEFORE the server accepts
+        # connections: a restarted coordinator re-adopts committed
+        # stage outputs and re-learns its membership from the journal,
+        # so reconnecting workers and a waiting driver resume instead
+        # of restarting from zero.
+        self.journal = None
+        self.journal_replay_ms = 0.0
+        if bool(conf.get(C.CLUSTER_JOURNAL_ENABLED)):
+            from spark_rapids_tpu.parallel.cluster.journal import Journal
+            self.journal = Journal(
+                os.path.join(self.base_dir, "journal", "journal.jsonl"),
+                fsync=bool(conf.get(C.CLUSTER_JOURNAL_FSYNC)))
+            t0 = time.monotonic()
+            self._replay()
+            self.journal_replay_ms = (time.monotonic() - t0) * 1000.0
         spec = str(conf.get(C.CLUSTER_COORDINATOR) or "")
         if spec:
             host, _, port = spec.rpartition(":")
@@ -607,6 +790,137 @@ class ClusterCoordinator:
         else:
             self.server = ClusterServer(self, "127.0.0.1", 0)
         self.addr = self.server.addr
+
+    # -- journal / failover ---------------------------------------------------
+    def _jlog(self, rec: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(rec)
+
+    def _backend(self, endpoint: str):
+        """Process-cached objectstore backend for coordinator-side
+        manifest checks and store cleanup."""
+        b = self._backends.get(endpoint)
+        if b is None:
+            from spark_rapids_tpu.parallel.transport.objectstore import \
+                make_backend
+            b = self._backends[endpoint] = make_backend(endpoint,
+                                                        timeout_s=2.0)
+        return b
+
+    def _objectstore_delete(self, endpoint: str, prefix: str) -> None:
+        """Best-effort key-prefix cleanup: a store outage during
+        cleanup degrades to garbage, never to a failed query."""
+        try:
+            b = self._backend(endpoint)
+            for k in b.list_keys(prefix):
+                try:
+                    b.delete(k)
+                except Exception:
+                    pass
+        except Exception as e:
+            _LOG.warning("objectstore cleanup of %s skipped: %s",
+                         prefix, e)
+
+    def _stage_committed(self, q: QueryRun, sid: int) -> bool:
+        """Is stage ``sid``'s durable output still published (a valid
+        committed manifest on the query's store)? The replay path uses
+        this to RE-ADOPT outputs that survived the coordinator crash
+        instead of recomputing them."""
+        from spark_rapids_tpu.parallel.transport.hostfile import \
+            valid_manifest
+        if q.store_kind == "objectstore":
+            try:
+                b = self._backend(q.store_endpoint)
+                m = json.loads(b.get(
+                    f"{q.store_prefix}/s{sid}/exchange.manifest.json"
+                ).decode("utf-8"))
+                return valid_manifest(m)
+            except Exception:
+                return False
+        path = os.path.join(q.qdir, f"s{sid}", "exchange.manifest.json")
+        try:
+            with open(path, encoding="utf-8") as f:
+                return valid_manifest(json.load(f))
+        except (OSError, ValueError):
+            return False
+
+    def _replay(self) -> None:
+        """Rebuild membership and per-query stage state from the
+        journal (coordinator failover): committed stage outputs whose
+        manifests still exist are re-adopted as DONE; tasks that were
+        in flight are restored RUNNING so the executing worker's
+        retried CDONE lands (its generation still matches) — if that
+        worker is truly gone, the heartbeat sweep requeues the task,
+        which is the ≤1-recompute bound the failover contract
+        promises."""
+        from spark_rapids_tpu.parallel.cluster import journal as J
+        recs = self.journal.records()
+        if not recs:
+            return
+        state = J.replay_state(recs)
+        now = time.monotonic()
+        for wid in state["workers"]:
+            self.workers[wid] = _WorkerInfo(wid, now)
+        recovered: List[int] = []
+        for qid in sorted(state["queries"]):
+            qs = state["queries"][qid]
+            sub = qs["submit"]
+            try:
+                conf = C.TpuConf(dict(sub.get("conf") or {}))
+                q = QueryRun(self, qid, conf, {}, {})
+                store = sub.get("store") or ["hostfile", "", ""]
+                q.store_kind, q.store_endpoint, q.store_prefix = \
+                    str(store[0]), str(store[1]), str(store[2])
+                q.plan_fp = str(sub.get("fp") or "")
+                if sub.get("pkl"):
+                    q.pkl_path = str(sub["pkl"])
+                # Re-verify the plan file on first dispatch: the crash
+                # may have landed between admission and the plan write.
+                q._pkl_ready = False
+                deps = {int(k): {int(x) for x in v} for k, v in
+                        (sub.get("deps") or {}).items()}
+                q.tasks = {int(s): _StageTask(int(s),
+                                              deps.get(int(s), set()))
+                           for s in sub["stages"]}
+                q.recomputes = int(qs.get("recomputes", 0))
+                for sid, ts in qs["tasks"].items():
+                    t = q.tasks.get(int(sid))
+                    if t is None:
+                        continue
+                    t.gen = int(ts["gen"])
+                    t.retries = int(ts["retries"])
+                    if ts["status"] == "done":
+                        if self._stage_committed(q, t.sid):
+                            t.status = _DONE
+                            t.bytes = int(ts["bytes"])
+                            t.producer = ts.get("wid")
+                        else:
+                            # The journaled output did not survive the
+                            # crash: one recompute, counted.
+                            q._requeue_locked(
+                                t, "journal replay: committed manifest"
+                                   " missing")
+                    elif ts["status"] == "running":
+                        t.status = _RUNNING
+                        t.worker = ts.get("wid")
+            except Exception:
+                _LOG.warning("journal replay: dropping unreadable "
+                             "query %s", qid, exc_info=True)
+                continue
+            self.queries[qid] = q
+            recovered.append(qid)
+        self._next_qid = max(self._next_qid, int(state["next_qid"]))
+        from spark_rapids_tpu import monitoring
+        monitoring.instant(
+            "coordinator-replay", "recovery",
+            args={"queries": recovered, "workers": state["workers"]})
+        self._jlog({"t": "replay", "queries": recovered,
+                    "workers": state["workers"]})
+        if recovered or state["workers"]:
+            _LOG.warning("cluster: journal replay recovered %d "
+                         "worker(s), %d in-flight quer%s",
+                         len(state["workers"]), len(recovered),
+                         "y" if len(recovered) == 1 else "ies")
 
     # -- membership/scheduling (socket threads) ------------------------------
     def _alive_count_locked(self) -> int:
@@ -624,6 +938,7 @@ class ClusterCoordinator:
             w = self.workers[wid] = _WorkerInfo(wid, now)
             monitoring.instant("worker-join", "cluster",
                                args={"worker": wid, "rejoin": not fresh})
+            self._jlog({"t": "reg", "wid": wid})
             _LOG.info("cluster: worker %s %sjoined", wid,
                       "" if fresh else "re")
         w.last_seen = now
@@ -736,6 +1051,64 @@ class ClusterCoordinator:
             blob = base64.b64encode(
                 json.dumps(self.stats()).encode()).decode()
             return f"OK {blob}\n".encode()
+        # -- remote-driver verbs (cluster.coordinator.remote) ----------------
+        if cmd == "CSUB" and len(parts) == 2:
+            spec = json.loads(base64.b64decode(parts[1]).decode())
+            qid, resp = self.submit_remote(spec)
+            blob = base64.b64encode(json.dumps(resp).encode()).decode()
+            return f"OK {qid} {blob}\n".encode()
+        if cmd == "CWAIT" and len(parts) == 2:
+            with self._lock:
+                self._check_workers_locked()
+                q = self.queries.get(int(parts[1]))
+                if q is None:
+                    payload = {"state": "unknown"}
+                else:
+                    if q.error is not None:
+                        state = "error"
+                    elif all(t.status == _DONE
+                             for t in q.tasks.values()):
+                        state = "done"
+                    else:
+                        state = "running"
+                    payload = {
+                        "state": state,
+                        "progress": q._progress(),
+                        "recomputes": q.recomputes,
+                        "gens": {str(t.sid): t.gen
+                                 for t in q.tasks.values()},
+                        "bytes": {str(t.sid): t.bytes
+                                  for t in q.tasks.values()
+                                  if t.status == _DONE},
+                        "error": str(q.error) if q.error else None}
+            blob = base64.b64encode(
+                json.dumps(payload).encode()).decode()
+            return f"OK {blob}\n".encode()
+        if cmd == "CREC" and len(parts) == 3:
+            with self._lock:
+                q = self.queries.get(int(parts[1]))
+            if q is not None:
+                q.recompute(int(parts[2]))
+            return b"OK\n"
+        if cmd == "CRESET" and len(parts) == 2:
+            with self._lock:
+                q = self.queries.get(int(parts[1]))
+            if q is not None:
+                q.reset()
+            return b"OK\n"
+        if cmd == "CFIN" and len(parts) == 2:
+            with self._lock:
+                q = self.queries.get(int(parts[1]))
+            if q is not None:
+                q.finish()
+            return b"OK\n"
+        if cmd == "CREPT" and len(parts) == 2:
+            with self._lock:
+                q = self.queries.get(int(parts[1]))
+                reports = dict(q.worker_reports) if q is not None else {}
+            blob = base64.b64encode(
+                json.dumps({"reports": reports}).encode()).decode()
+            return f"OK {blob}\n".encode()
         return None
 
     def stats(self) -> dict:
@@ -758,6 +1131,31 @@ class ClusterCoordinator:
             }
 
     # -- query submission (driver thread) ------------------------------------
+    def _store_params(self, conf) -> Tuple[str, str]:
+        """(store kind, endpoint) for a new query's stage outputs."""
+        kind = cluster_store_kind(conf)
+        endpoint = ""
+        if kind == "objectstore":
+            from spark_rapids_tpu.parallel.transport.objectstore import \
+                resolve_endpoint
+            endpoint = resolve_endpoint(conf)
+        return kind, endpoint
+
+    @staticmethod
+    def _broadcast_maps(graph, deps) -> Tuple[Dict[int, int],
+                                              Dict[int, List[int]]]:
+        """(bcast_tags, bcast_deps) for the broadcast artifact cache:
+        each broadcast-boundary stage keyed by its exchange identity,
+        plus the dispatchable upstream stages whose generations key the
+        cache entry."""
+        from spark_rapids_tpu.parallel.exchange import \
+            BroadcastExchangeExec
+        tags = {id(st.boundary): sid for sid, st in graph.stages.items()
+                if isinstance(st.boundary, BroadcastExchangeExec)}
+        bdeps = {sid: sorted(deps.get(sid, ()))
+                 for sid in tags.values()}
+        return tags, bdeps
+
     def submit(self, phys, conf, graph=None,
                binds=None) -> Optional[QueryRun]:
         """Partition ``phys``'s stage DAG into dispatchable tasks and
@@ -769,6 +1167,11 @@ class ClusterCoordinator:
         _, dispatchable, deps = stage_plan(phys.root, graph)
         if not dispatchable:
             return None
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+        kind, endpoint = self._store_params(conf)
+        prefix = f"{self.ns}/q{qid}" if kind == "objectstore" else ""
         worker_raw = {
             k: v for k, v in phys.conf.raw.items()
             # Conf-armed fault schedules stay driver-side: a spec
@@ -777,6 +1180,16 @@ class ClusterCoordinator:
             # each worker's SRT_FAULTS environment instead.
             if not k.startswith("spark.rapids.sql.test.faults")
             and k != C.CLUSTER_ENABLED.key}
+        if kind == "objectstore":
+            # Pin the query's store coordinates into the shipped conf:
+            # every worker (and the driver's fetch sessions) resolves
+            # the SAME endpoint + key prefix regardless of its local
+            # env, so the store is part of the plan, not the ambiance.
+            worker_raw[C.SHUFFLE_TRANSPORT.key] = "objectstore"
+            worker_raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_ENDPOINT.key] = \
+                endpoint
+            worker_raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_PREFIX.key] = \
+                prefix
         try:
             blob = pickle.dumps((phys.root, worker_raw, binds))
         except Exception as e:
@@ -784,9 +1197,8 @@ class ClusterCoordinator:
                          "standing down to local execution",
                          type(e).__name__, e)
             return None
+        bcast_tags, bcast_deps = self._broadcast_maps(graph, deps)
         with self._lock:
-            qid = self._next_qid
-            self._next_qid += 1
             tasks = {sid: _StageTask(sid, deps.get(sid, set())
                                      & dispatchable)
                      for sid in dispatchable}
@@ -796,14 +1208,78 @@ class ClusterCoordinator:
             q = QueryRun(self, qid, conf, tasks, driver_tags)
             q._blob = blob
             q._root = phys.root
+            q.store_kind, q.store_endpoint, q.store_prefix = \
+                kind, endpoint, prefix
+            q.plan_fp = hashlib.sha256(blob).hexdigest()[:12]
+            q._bcast_tags = bcast_tags
+            q._bcast_deps = bcast_deps
             os.makedirs(q.qdir, exist_ok=True)
             self._write_plan(q)
+            q._pkl_ready = True
             self.queries[qid] = q
+        self._jlog({
+            "t": "submit", "qid": qid,
+            "stages": sorted(dispatchable),
+            "deps": {str(s): sorted(deps.get(s, set()) & dispatchable)
+                     for s in dispatchable},
+            "conf": worker_raw, "pkl": q.pkl_path,
+            "store": [kind, endpoint, prefix], "fp": q.plan_fp})
         from spark_rapids_tpu import monitoring
         monitoring.instant("cluster-submit", "cluster",
                            args={"query": qid,
                                  "stages": len(dispatchable)})
         return q
+
+    def submit_remote(self, spec: dict) -> Tuple[int, dict]:
+        """CSUB: admit a query whose PLAN stays driver-side — the
+        remote driver ships only the stage DAG metadata (stage ids,
+        dispatchable deps, worker conf, store coordinates, plan
+        fingerprint) and then writes the plan pickle to the returned
+        path itself. Dispatch holds until that file lands
+        (``_pkl_ready`` gate in ``_pick_locked``)."""
+        stages = [int(s) for s in spec["stages"]]
+        deps = {int(k): {int(x) for x in v}
+                for k, v in (spec.get("deps") or {}).items()}
+        conf = C.TpuConf(dict(spec.get("conf") or {}))
+        kind = str(spec.get("store_kind") or "hostfile")
+        endpoint = str(spec.get("endpoint") or "")
+        with self._lock:
+            qid = self._next_qid
+            self._next_qid += 1
+            prefix = f"{self.ns}/q{qid}" if kind == "objectstore" \
+                else ""
+            tasks = {sid: _StageTask(sid, deps.get(sid, set())
+                                     & set(stages))
+                     for sid in stages}
+            q = QueryRun(self, qid, conf, tasks, {})
+            q._pkl_ready = False
+            q.pkl_path = os.path.join(self.base_dir, "plans",
+                                      f"q{qid}.pkl")
+            q.store_kind, q.store_endpoint, q.store_prefix = \
+                kind, endpoint, prefix
+            q.plan_fp = str(spec.get("fp") or "")
+            os.makedirs(q.qdir, exist_ok=True)
+            os.makedirs(os.path.dirname(q.pkl_path), exist_ok=True)
+            self.queries[qid] = q
+        worker_raw = dict(spec.get("conf") or {})
+        if kind == "objectstore":
+            worker_raw[C.SHUFFLE_TRANSPORT.key] = "objectstore"
+            worker_raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_ENDPOINT.key] = \
+                endpoint
+            worker_raw[C.SHUFFLE_TRANSPORT_OBJECTSTORE_PREFIX.key] = \
+                prefix
+        self._jlog({
+            "t": "submit", "qid": qid, "stages": sorted(stages),
+            "deps": {str(s): sorted(deps.get(s, set()) & set(stages))
+                     for s in stages},
+            "conf": worker_raw, "pkl": q.pkl_path,
+            "store": [kind, endpoint, prefix], "fp": q.plan_fp})
+        from spark_rapids_tpu import monitoring
+        monitoring.instant("cluster-submit", "cluster",
+                           args={"query": qid, "stages": len(stages),
+                                 "remote": True})
+        return qid, {"pkl": q.pkl_path, "prefix": prefix,
+                     "conf": worker_raw}
 
     def _write_plan(self, q: QueryRun) -> None:
         tmp = q.pkl_path + ".tmp"
@@ -811,9 +1287,13 @@ class ClusterCoordinator:
             f.write(q._blob)
         os.replace(tmp, q.pkl_path)
 
-    def close(self) -> None:
+    def close(self, remove_dir: bool = True) -> None:
+        """Stop the server; ``remove_dir=False`` keeps the cluster dir
+        (journal + plans + spool) — the standalone coordinator uses it
+        so a SIGKILL'd-then-restarted process can replay."""
         self.server.close()
-        shutil.rmtree(self.base_dir, ignore_errors=True)
+        if remove_dir:
+            shutil.rmtree(self.base_dir, ignore_errors=True)
 
 
 # -- process-global coordinator (driver side) --------------------------------
@@ -855,12 +1335,19 @@ def maybe_prepare(phys, ctx, graph=None) -> Optional[QueryRun]:
     from spark_rapids_tpu.parallel import transport as T
     if T.transport_name(conf) == "mesh":
         return None             # collective exchange owns the shuffle
-    co = get_coordinator(conf)
     binds = None
     if "plan_binds" in ctx.cache:
         # A plan-cache template executes against per-collect bound
         # literals; workers need them to resolve bind slots.
         binds = (ctx.cache["plan_binds"], ctx.cache["plan_bind_dtypes"])
+    if bool(conf.get(C.CLUSTER_COORDINATOR_REMOTE)):
+        # Out-of-process coordinator (failover mode): the driver is a
+        # CLIENT — it submits over the wire and survives coordinator
+        # restarts. See parallel/cluster/remote.py.
+        from spark_rapids_tpu.parallel.cluster.remote import \
+            remote_prepare
+        return remote_prepare(phys, ctx, conf, graph)
+    co = get_coordinator(conf)
     q = co.submit(phys, conf, graph, binds)
     if q is None:
         return None
@@ -868,3 +1355,56 @@ def maybe_prepare(phys, ctx, graph=None) -> Optional[QueryRun]:
     m = q._metrics()
     m.add("stagesDispatched", len(q.tasks))
     return q
+
+
+# -- standalone coordinator process ------------------------------------------
+
+def main(argv=None) -> int:
+    """``python -m spark_rapids_tpu.parallel.cluster.coordinator`` — a
+    coordinator that outlives any one driver process. Pairs with
+    ``cluster.coordinator.remote=true`` drivers; the journal (on by
+    default here) makes it SIGKILL-restartable in place: restart with
+    the same ``--dir`` and ``--listen`` and in-flight queries resume
+    with at most one recompute per interrupted stage."""
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="standalone srt cluster coordinator")
+    ap.add_argument("--listen", required=True,
+                    help="host:port to bind (workers + drivers connect "
+                         "here)")
+    ap.add_argument("--dir", required=True,
+                    help="cluster state dir (journal, plans, spool) — "
+                         "reuse it across restarts to recover")
+    ap.add_argument("--heartbeat-timeout-ms", type=int, default=None)
+    args = ap.parse_args(argv)
+    raw = {C.CLUSTER_COORDINATOR.key: args.listen,
+           C.CLUSTER_DIR.key: args.dir,
+           C.CLUSTER_JOURNAL_ENABLED.key: True}
+    if args.heartbeat_timeout_ms is not None:
+        raw[C.CLUSTER_HEARTBEAT_TIMEOUT_MS.key] = \
+            args.heartbeat_timeout_ms
+    conf = C.TpuConf(raw)
+    co = ClusterCoordinator(conf)
+    host, port = co.addr
+    print(f"coordinator listening at {host}:{port}", flush=True)
+    if co.journal_replay_ms:
+        print(f"journal replayed in {co.journal_replay_ms:.1f}ms",
+              flush=True)
+    try:
+        # The monitor loop replaces QueryRun.run's driver-side
+        # heartbeat sweep: with only REMOTE drivers there is no local
+        # run() loop, so dead workers must be detected here.
+        while True:
+            time.sleep(co.hb_timeout_ms / 3000.0)
+            with co._lock:
+                co._check_workers_locked()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        co.close(remove_dir=False)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

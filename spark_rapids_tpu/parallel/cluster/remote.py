@@ -1,0 +1,309 @@
+"""Remote-coordinator driver client (coordinator failover, ISSUE 17).
+
+In the default cluster mode the COORDINATOR lives inside the driver
+process, so a driver crash takes the control plane with it. This module
+is the other arrangement: a standalone coordinator process
+(``python -m spark_rapids_tpu.parallel.cluster.coordinator``) owns
+membership, scheduling, and the write-ahead journal, while the driver
+is a mere CLIENT (``cluster.coordinator.remote=true``):
+
+- :func:`remote_prepare` submits the stage DAG over the wire (``CSUB``
+  ships only metadata — stage ids, deps, worker conf, store
+  coordinates; the plan pickle is written by the driver to the path the
+  coordinator returns, and dispatch holds until it lands);
+- :class:`RemoteQueryRun` mirrors the in-process ``QueryRun`` driver
+  surface the planner drives (run/recompute/reset/install/finish) with
+  one wire verb each, and its ``run`` loop RIDES OUT coordinator
+  outages: an unreachable coordinator is polled again with backoff
+  until the dispatch deadline, so a SIGKILL'd-and-restarted coordinator
+  (which replays its journal and re-adopts committed stage outputs)
+  resumes the query with at most one recompute per interrupted stage —
+  the driver never sees an error, only a longer wait.
+
+Recompute accounting: the coordinator counts stage recomputes in ITS
+process; ``CWAIT`` carries the cumulative count and the driver mirrors
+positive deltas into its local fault counters, so chaos tests assert
+the ≤1-recompute bound against the driver exactly as in-process runs
+do.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import logging
+import os
+import pickle
+import time
+from typing import Dict, List, Optional, Tuple
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.parallel.cluster.coordinator import (
+    ClusterDispatchError, ClusterExecInfo, cluster_store_kind,
+    merge_worker_reports, stage_plan)
+from spark_rapids_tpu.parallel.transport.rendezvous import (
+    RendezvousUnavailableError, _roundtrip, parse_addr)
+
+_LOG = logging.getLogger("spark_rapids_tpu.cluster")
+
+
+class RemoteQueryRun:
+    """Driver-side handle on a query dispatched through a REMOTE
+    coordinator. Implements the planner's QueryRun protocol (install /
+    run / recompute / reset / finish); every verb is one rendezvous
+    round trip."""
+
+    def __init__(self, addr: Tuple[str, int], qid: int, conf,
+                 base_dir: str, pkl_path: str, stages: List[int],
+                 store: Tuple[str, str, str], plan_fp: str,
+                 driver_tags, bcast_tags, bcast_deps, root):
+        self.addr = addr
+        self.qid = qid
+        self.base_dir = base_dir
+        self.qdir = os.path.join(base_dir, f"q{qid}")
+        self.pkl_path = pkl_path
+        self.stages = stages
+        self.store_kind, self.store_endpoint, self.store_prefix = store
+        self.plan_fp = plan_fp
+        self._driver_tags = driver_tags
+        self._bcast_tags = bcast_tags
+        self._bcast_deps = bcast_deps
+        self._root = root
+        self._ctx = None
+        self._trace_qid = 0
+        self.finished = False
+        self._gens: Dict[int, int] = {sid: 0 for sid in stages}
+        self._last_recomputes = 0
+        self.poll_ms = max(int(conf.get(C.CLUSTER_POLL_MS)), 1)
+        self.dispatch_timeout_ms = max(
+            int(conf.get(C.CLUSTER_DISPATCH_TIMEOUT_MS)), 1)
+
+    # -- wire ----------------------------------------------------------------
+    def _call(self, line: str, timeout_s: float = 10.0,
+              retries: int = 3) -> str:
+        if not line.endswith("\n"):
+            line += "\n"
+        return _roundtrip(self.addr, line, timeout_s=timeout_s,
+                          retries=retries, backoff_ms=50)
+
+    def _call_resilient(self, line: str, what: str) -> Optional[str]:
+        """Best-effort control verb: a coordinator mid-restart loses
+        nothing by missing it (recompute/reset re-derive from the
+        journal + store state), so log and move on."""
+        try:
+            return self._call(line, retries=5)
+        except RendezvousUnavailableError as e:
+            _LOG.warning("cluster: %s for query %d not delivered "
+                         "(coordinator unreachable): %s", what,
+                         self.qid, e)
+            return None
+
+    # -- planner hooks -------------------------------------------------------
+    def install(self, ctx) -> None:
+        self._ctx = ctx
+        self._trace_qid = ctx.cache.get("trace_query", 0)
+        ctx.cache["cluster"] = ClusterExecInfo(
+            self.qdir, f"drv{os.getpid()}", self._driver_tags,
+            local_sid=None, store_kind=self.store_kind,
+            store_endpoint=self.store_endpoint,
+            store_prefix=self.store_prefix,
+            bcast_tags=self._bcast_tags, bcast_deps=self._bcast_deps,
+            plan_fp=self.plan_fp,
+            gen_source=lambda: dict(self._gens))
+
+    def _metrics(self):
+        from spark_rapids_tpu.ops.base import query_metrics_entry
+        return query_metrics_entry(self._ctx, "Cluster")
+
+    def run(self, ctx) -> None:
+        """Dispatch-and-wait barrier over the wire. An unreachable
+        coordinator does NOT fail the query — this loop keeps polling
+        until the dispatch deadline, which is exactly the failover
+        window: kill the coordinator, restart it against the same
+        ``--dir``, and the journal replay puts the query back where it
+        was."""
+        from spark_rapids_tpu import faults, monitoring
+        t0 = time.monotonic()
+        deadline = t0 + self.dispatch_timeout_ms / 1000.0
+        was_unreachable = False
+        while True:
+            faults.check_cancelled()
+            if time.monotonic() > deadline:
+                raise ClusterDispatchError(
+                    f"UNAVAILABLE: cluster dispatch of query {self.qid} "
+                    f"incomplete after {self.dispatch_timeout_ms}ms "
+                    f"(remote coordinator)")
+            try:
+                resp = self._call(f"CWAIT {self.qid}", timeout_s=5.0,
+                                  retries=1)
+            except RendezvousUnavailableError:
+                if not was_unreachable:
+                    was_unreachable = True
+                    monitoring.instant(
+                        "coordinator-unreachable", "recovery",
+                        args={"query": self.qid}, qid=self._trace_qid)
+                    _LOG.warning("cluster: coordinator %s:%d "
+                                 "unreachable — riding out the outage "
+                                 "(query %d)", self.addr[0],
+                                 self.addr[1], self.qid)
+                time.sleep(0.2)
+                continue
+            if was_unreachable:
+                was_unreachable = False
+                monitoring.instant("coordinator-reconnected",
+                                   "recovery",
+                                   args={"query": self.qid},
+                                   qid=self._trace_qid)
+                _LOG.warning("cluster: coordinator back — resuming "
+                             "wait for query %d", self.qid)
+            if not resp.startswith("OK "):
+                raise ClusterDispatchError(
+                    f"cluster coordinator rejected CWAIT: {resp!r}")
+            payload = json.loads(base64.b64decode(resp[3:]).decode())
+            state = payload.get("state")
+            for sid_s, gen in (payload.get("gens") or {}).items():
+                self._gens[int(sid_s)] = int(gen)
+            rec = int(payload.get("recomputes") or 0)
+            if rec > self._last_recomputes:
+                # Mirror the coordinator's recompute count into the
+                # driver's fault counters (it counted them in its own
+                # process) so chaos assertions see them here.
+                delta = rec - self._last_recomputes
+                self._last_recomputes = rec
+                for _ in range(delta):
+                    faults.record("stageRecomputes")
+                if self._ctx is not None:
+                    self._metrics().add("tasksRequeued", delta)
+            if state == "error":
+                raise ClusterDispatchError(
+                    payload.get("error")
+                    or f"query {self.qid} failed at the coordinator")
+            if state == "unknown":
+                raise ClusterDispatchError(
+                    f"UNAVAILABLE: coordinator does not know query "
+                    f"{self.qid} (restarted without its journal?)")
+            if state == "done":
+                break
+            time.sleep(self.poll_ms / 1000.0)
+        m = self._metrics()
+        m.add("dispatchWaitMs", (time.monotonic() - t0) * 1000.0)
+        monitoring.instant(
+            "cluster-dispatch-complete", "cluster",
+            args={"query": self.qid, "stages": len(self.stages),
+                  "remote": True}, qid=self._trace_qid)
+        self._fetch_reports(ctx)
+
+    def _fetch_reports(self, ctx) -> None:
+        resp = self._call_resilient(f"CREPT {self.qid}",
+                                    "worker-report fetch")
+        if resp is None or not resp.startswith("OK "):
+            return
+        try:
+            reports = json.loads(
+                base64.b64decode(resp[3:]).decode()).get("reports") or {}
+            merge_worker_reports(ctx, self._root, reports)
+        except Exception:       # stats must never fail the query
+            _LOG.warning("cluster: worker-report merge failed",
+                         exc_info=True)
+
+    def recompute(self, sid: int) -> None:
+        self._call_resilient(f"CREC {self.qid} {sid}",
+                             f"recompute of stage s{sid}")
+
+    def reset(self) -> None:
+        self._call_resilient(f"CRESET {self.qid}", "query reset")
+
+    def finish(self) -> None:
+        self.finished = True
+        self._call_resilient(f"CFIN {self.qid}", "query finish")
+
+
+def remote_prepare(phys, ctx, conf, graph=None):
+    """The remote-mode branch of ``cluster.maybe_prepare``: submit over
+    the wire and return a :class:`RemoteQueryRun`, or None to stand
+    down to local execution (no coordinator address, no dispatchable
+    stage, unpicklable plan, or a coordinator that is down at SUBMIT
+    time — failover covers mid-query crashes, not a cluster that never
+    existed)."""
+    addr = parse_addr(str(conf.get(C.CLUSTER_COORDINATOR) or ""))
+    base_dir = str(conf.get(C.CLUSTER_DIR) or "")
+    if addr is None or not base_dir:
+        _LOG.warning("cluster: coordinator.remote=true needs both "
+                     "cluster.coordinator and cluster.dir — running "
+                     "locally")
+        return None
+    g, dispatchable, deps = stage_plan(phys.root, graph)
+    if not dispatchable:
+        return None
+    worker_raw = {
+        k: v for k, v in phys.conf.raw.items()
+        if not k.startswith("spark.rapids.sql.test.faults")
+        and k not in (C.CLUSTER_ENABLED.key,
+                      C.CLUSTER_COORDINATOR_REMOTE.key)}
+    binds = None
+    if "plan_binds" in ctx.cache:
+        binds = (ctx.cache["plan_binds"], ctx.cache["plan_bind_dtypes"])
+    try:
+        pickle.dumps((phys.root, worker_raw, binds))
+    except Exception as e:
+        _LOG.warning("cluster: plan not picklable (%s: %s) — standing "
+                     "down to local execution", type(e).__name__, e)
+        return None
+    kind = cluster_store_kind(conf)
+    endpoint = ""
+    if kind == "objectstore":
+        from spark_rapids_tpu.parallel.transport.objectstore import \
+            resolve_endpoint
+        endpoint = resolve_endpoint(conf)
+    spec = {
+        "stages": sorted(dispatchable),
+        "deps": {str(s): sorted(deps.get(s, set()) & dispatchable)
+                 for s in dispatchable},
+        "conf": worker_raw, "store_kind": kind, "endpoint": endpoint,
+    }
+    blob64 = base64.b64encode(json.dumps(spec).encode()).decode()
+    try:
+        resp = _roundtrip(addr, f"CSUB {blob64}\n", timeout_s=10.0,
+                          retries=3, backoff_ms=50)
+    except RendezvousUnavailableError as e:
+        _LOG.warning("cluster: coordinator %s unreachable at submit — "
+                     "running locally: %s", addr, e)
+        return None
+    parts = resp.split()
+    if len(parts) != 3 or parts[0] != "OK":
+        _LOG.warning("cluster: CSUB rejected (%r) — running locally",
+                     resp)
+        return None
+    qid = int(parts[1])
+    grant = json.loads(base64.b64decode(parts[2]).decode())
+    pkl_path = grant["pkl"]
+    prefix = grant.get("prefix") or ""
+    pinned_raw = grant.get("conf") or worker_raw
+    # The plan pickle carries the PINNED conf (store endpoint + the
+    # query's key prefix), so every worker resolves the same store
+    # coordinates regardless of its local environment.
+    plan_blob = pickle.dumps((phys.root, pinned_raw, binds))
+    os.makedirs(os.path.dirname(pkl_path), exist_ok=True)
+    tmp = pkl_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(plan_blob)
+    os.replace(tmp, pkl_path)
+    plan_fp = hashlib.sha256(plan_blob).hexdigest()[:12]
+    driver_tags = {id(g.stages[sid].boundary): (sid, f"s{sid}")
+                   for sid in dispatchable}
+    from spark_rapids_tpu.parallel.cluster.coordinator import \
+        ClusterCoordinator
+    bcast_tags, bcast_deps = ClusterCoordinator._broadcast_maps(g, deps)
+    q = RemoteQueryRun(addr, qid, conf, base_dir, pkl_path,
+                       sorted(dispatchable), (kind, endpoint, prefix),
+                       plan_fp, driver_tags, bcast_tags, bcast_deps,
+                       phys.root)
+    q.install(ctx)
+    m = q._metrics()
+    m.add("stagesDispatched", len(dispatchable))
+    from spark_rapids_tpu import monitoring
+    monitoring.instant("cluster-submit", "cluster",
+                       args={"query": qid, "stages": len(dispatchable),
+                             "remote": True})
+    return q

@@ -41,6 +41,7 @@ if __name__ == "__main__":          # bare-script env hygiene, before jax
 
 import argparse
 import base64
+import hashlib
 import json
 import logging
 import pickle
@@ -85,12 +86,13 @@ class _QueryState:
 class Worker:
     def __init__(self, coordinator: Tuple[str, int], worker_id: str,
                  poll_ms: int = 25, heartbeat_ms: int = 2000,
-                 max_idle_s: float = 0.0):
+                 max_idle_s: float = 0.0, reconnect_s: float = 120.0):
         self.addr = coordinator
         self.wid = worker_id
         self.poll_ms = max(int(poll_ms), 1)
         self.heartbeat_ms = max(int(heartbeat_ms), 1)
         self.max_idle_s = float(max_idle_s)
+        self.reconnect_s = float(reconnect_s)
         self.queries: Dict[int, _QueryState] = {}
         self._stop = threading.Event()
         self.tasks_done = 0
@@ -102,6 +104,59 @@ class Worker:
             line += "\n"
         return RV._roundtrip(self.addr, line, timeout_s=timeout_s,
                              retries=3, backoff_ms=50)
+
+    def _call_persistent(self, line: str, deadline_s: float) -> bool:
+        """Deliver a must-arrive verb (CDONE/CFAIL) across a
+        coordinator outage: keep retrying with capped backoff until the
+        deadline. A restarted coordinator replays its journal, restores
+        the task RUNNING under this worker's generation, and the
+        retried report lands exactly as if nothing happened."""
+        from spark_rapids_tpu.parallel.transport.rendezvous import \
+            RendezvousUnavailableError
+        end = time.monotonic() + deadline_s
+        delay = 0.1
+        while True:
+            try:
+                self._call(line, timeout_s=5.0)
+                return True
+            except RendezvousUnavailableError:
+                if self._stop.is_set() or time.monotonic() >= end:
+                    _LOG.warning("worker %s: gave up delivering %r "
+                                 "after %.0fs", self.wid,
+                                 line.split()[0], deadline_s)
+                    return False
+                time.sleep(delay)
+                delay = min(delay * 2, 2.0)
+
+    def _reconnect(self) -> bool:
+        """Ride out a coordinator outage (THE fix for the old
+        die-on-refused behavior): back off with a 2s cap inside the
+        reconnect window, then re-register. Loaded queries, their
+        warm execution contexts, spooled stage state, and kernel
+        caches all survive — a coordinator restart costs this worker
+        one CREG, not its whole state."""
+        from spark_rapids_tpu import monitoring
+        from spark_rapids_tpu.parallel.transport.rendezvous import \
+            RendezvousUnavailableError
+        end = time.monotonic() + self.reconnect_s
+        delay = 0.1
+        _LOG.warning("worker %s: coordinator unreachable — "
+                     "reconnecting for up to %.0fs", self.wid,
+                     self.reconnect_s)
+        while not self._stop.is_set() and time.monotonic() < end:
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
+            try:
+                self._call(f"CREG {self.wid}", timeout_s=5.0)
+            except RendezvousUnavailableError:
+                continue
+            monitoring.instant("worker-reconnect", "recovery",
+                               args={"worker": self.wid})
+            _LOG.warning("worker %s: re-registered with coordinator "
+                         "(queries kept warm: %s)", self.wid,
+                         sorted(self.queries) or "none")
+            return True
+        return False
 
     def register(self, deadline_s: float = 30.0) -> None:
         """CREG with retry-until-deadline: the launcher may start
@@ -155,18 +210,46 @@ class Worker:
         from spark_rapids_tpu import faults, monitoring
         from spark_rapids_tpu.ops.base import ExecContext
         from spark_rapids_tpu.parallel.cluster.coordinator import (
-            ClusterExecInfo, stage_plan)
+            ClusterCoordinator, ClusterExecInfo, cluster_store_kind,
+            stage_plan)
         with open(pkl_path, "rb") as f:
-            root, raw, binds = pickle.loads(f.read())
+            blob = f.read()
+        root, raw, binds = pickle.loads(blob)
         conf = C.TpuConf(raw)
         monitoring.maybe_configure(conf)
         monitoring.telemetry.maybe_configure(conf)
         faults.maybe_configure(conf)
-        graph, dispatchable, _ = stage_plan(root)
+        graph, dispatchable, deps = stage_plan(root)
         tags = {id(graph.stages[sid].boundary): (sid, f"s{sid}")
                 for sid in dispatchable}
-        info = ClusterExecInfo(os.path.dirname(pkl_path), self.wid,
-                               tags, local_sid=None)
+        # Store coordinates ride IN the shipped conf (submit pins
+        # them), so every worker publishes/fetches through the same
+        # endpoint + key prefix the driver resolved. The spool dir
+        # fallback: remote submissions park the plan under <dir>/plans,
+        # so derive the query spool from the cluster dir, not the
+        # pickle's parent.
+        kind = cluster_store_kind(conf)
+        endpoint = prefix = ""
+        if kind == "objectstore":
+            endpoint = str(conf.get(
+                C.SHUFFLE_TRANSPORT_OBJECTSTORE_ENDPOINT) or "")
+            prefix = str(conf.get(
+                C.SHUFFLE_TRANSPORT_OBJECTSTORE_PREFIX) or "")
+        pkl_dir = os.path.dirname(pkl_path)
+        if os.path.basename(pkl_dir) == "plans":
+            spool = os.path.join(os.path.dirname(pkl_dir), f"q{qid}")
+        else:
+            spool = pkl_dir
+        bcast_tags, bcast_deps = \
+            ClusterCoordinator._broadcast_maps(graph, deps)
+        st_holder: list = []
+        info = ClusterExecInfo(
+            spool, self.wid, tags, local_sid=None, store_kind=kind,
+            store_endpoint=endpoint, store_prefix=prefix,
+            bcast_tags=bcast_tags, bcast_deps=bcast_deps,
+            plan_fp=hashlib.sha256(blob).hexdigest()[:12],
+            gen_source=lambda: dict(st_holder[0].gens)
+            if st_holder else {})
         ctx = ExecContext(conf)
         ctx.cache["engine"] = "device"
         ctx.cache["cluster"] = info
@@ -177,6 +260,7 @@ class Worker:
             ctx.cache["plan_binds"] = tuple(binds[0])
             ctx.cache["plan_bind_dtypes"] = tuple(binds[1])
         st = _QueryState(root, conf, graph, info, ctx)
+        st_holder.append(st)
         self.queries[qid] = st
         _LOG.info("worker %s: loaded query %d (%d dispatchable stages)",
                   self.wid, qid, len(dispatchable))
@@ -242,15 +326,19 @@ class Worker:
             _LOG.warning("worker %s: stage s%d of query %d failed "
                          "(lost dep: %s): %s", self.wid, sid, qid,
                          lost, e, exc_info=True)
-            self._call(f"CFAIL {self.wid} {qid} {sid} {gen} "
-                       f"{'-' if lost is None else lost} {msg}")
+            self._call_persistent(
+                f"CFAIL {self.wid} {qid} {sid} {gen} "
+                f"{'-' if lost is None else lost} {msg}",
+                deadline_s=self.reconnect_s)
             return
         finally:
             st.info.set_local(None)
         self.tasks_done += 1
         extra = self._stage_report(st)
-        self._call(f"CDONE {self.wid} {qid} {sid} {gen} {nbytes}"
-                   + (f" {extra}" if extra else ""))
+        self._call_persistent(
+            f"CDONE {self.wid} {qid} {sid} {gen} {nbytes}"
+            + (f" {extra}" if extra else ""),
+            deadline_s=self.reconnect_s)
 
     def _stage_report(self, st: _QueryState) -> Optional[str]:
         """b64(JSON) CDONE piggyback: this query's per-node observed
@@ -340,8 +428,12 @@ class Worker:
                 try:
                     resp = self._call(f"CPOLL {self.wid} {known}")
                 except RendezvousUnavailableError:
-                    _LOG.warning("worker %s: coordinator unreachable — "
-                                 "exiting", self.wid)
+                    if self._reconnect():
+                        idle_since = time.monotonic()
+                        continue
+                    _LOG.warning("worker %s: coordinator unreachable "
+                                 "past the %.0fs reconnect window — "
+                                 "exiting", self.wid, self.reconnect_s)
                     return 1
                 parts = resp.split()
                 if parts and parts[0] == "CTASK":
@@ -387,6 +479,9 @@ def main(argv=None) -> int:
     ap.add_argument("--heartbeat-ms", type=int, default=2000)
     ap.add_argument("--max-idle-s", type=float, default=0.0,
                     help="exit after this long without a task (0=never)")
+    ap.add_argument("--reconnect-s", type=float, default=120.0,
+                    help="how long to ride out a coordinator outage "
+                         "before exiting")
     ap.add_argument("--log-level", default="INFO")
     a = ap.parse_args(argv)
     logging.basicConfig(
@@ -395,7 +490,7 @@ def main(argv=None) -> int:
     host, _, port = a.coordinator.rpartition(":")
     w = Worker((host or "127.0.0.1", int(port)), a.worker_id,
                poll_ms=a.poll_ms, heartbeat_ms=a.heartbeat_ms,
-               max_idle_s=a.max_idle_s)
+               max_idle_s=a.max_idle_s, reconnect_s=a.reconnect_s)
     signal.signal(signal.SIGTERM, lambda *_: w.stop())
     return w.run()
 

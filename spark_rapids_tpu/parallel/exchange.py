@@ -593,6 +593,17 @@ class BroadcastExchangeExec(Exec):
             batch = handle.get()
             handle.release(PRIORITY_BROADCAST)
             return batch
+        # Cluster broadcast artifact cache (parallel/broadcast_cache.py):
+        # another process of this query may have already built and
+        # published this single — adopt it instead of re-collecting the
+        # child. The fetched handle satisfies the same get/release
+        # protocol as the SpillableBatch below. No-op outside cluster
+        # mode.
+        from spark_rapids_tpu.parallel import broadcast_cache as BC
+        hit = BC.maybe_fetch(ctx, self)
+        if hit is not None:
+            ctx.cache[key] = hit[0]
+            return hit[1]
         from spark_rapids_tpu import monitoring
         from spark_rapids_tpu.parallel import pipeline as PL
         nchild = self.children[0].num_partitions(ctx)
@@ -625,6 +636,9 @@ class BroadcastExchangeExec(Exec):
             concat_batches(batches, bucket_capacity(total))
         ctx.cache[key] = SpillableBatch(ctx.catalog, single,
                                         PRIORITY_BROADCAST)
+        # Publish the freshly-built single for the query's OTHER
+        # processes (best-effort; no-op outside cluster mode).
+        BC.maybe_publish(ctx, self, single)
         return single
 
     def collect_single_host(self, ctx) -> HostBatch:

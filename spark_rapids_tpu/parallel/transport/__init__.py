@@ -11,6 +11,10 @@ Three built-in transports, one SPI (see base.py and docs/shuffle.md):
   with a manifest/socket rendezvous, so N independent worker processes
   can map-write and reduce-fetch each other's shards (the DCN
   multi-slice stand-in).
+- ``objectstore`` — the same contract keyed into a flat object
+  namespace behind a pluggable put/get/list backend (HTTP stub shipped;
+  the S3/GCS stand-in), with bounded retry + deterministic-jitter
+  backoff on transient backend errors (objectstore.py).
 
 Selection: ``spark.rapids.sql.shuffle.transport`` conf, then the
 ``SRT_SHUFFLE_TRANSPORT`` env (whole-process override, the CI matrix
@@ -83,10 +87,17 @@ def _make_mesh() -> ShuffleTransport:
     return MeshTransport()
 
 
+def _make_objectstore() -> ShuffleTransport:
+    from spark_rapids_tpu.parallel.transport.objectstore import \
+        ObjectStoreTransport
+    return ObjectStoreTransport()
+
+
 _REGISTRY: Dict[str, Callable[[], ShuffleTransport]] = {
     "inprocess": _make_inprocess,
     "hostfile": _make_hostfile,
     "mesh": _make_mesh,
+    "objectstore": _make_objectstore,
 }
 _INSTANCES: Dict[str, ShuffleTransport] = {}
 

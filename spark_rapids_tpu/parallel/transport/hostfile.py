@@ -55,6 +55,34 @@ def default_spool_dir() -> str:
                         f"srt_shuffle_{os.getpid()}")
 
 
+def valid_manifest(m) -> bool:
+    """Torn-manifest guard, shared by every file/object transport: a
+    manifest counts as PUBLISHED only if it parsed into the complete
+    schema commit() writes. A manifest written WITHOUT the atomic
+    rename (a crashed writer, a non-atomic copy onto the spool, a
+    truncated upload) must read as 'not yet published' and keep the
+    fetcher polling — never surface as a KeyError/TypeError crash deep
+    in fetch_shards."""
+    if not isinstance(m, dict):
+        return False
+    if not isinstance(m.get("worker"), str):
+        return False
+    if not isinstance(m.get("num_partitions"), int):
+        return False
+    shards = m.get("shards")
+    if not isinstance(shards, dict):
+        return False
+    for entries in shards.values():
+        if not isinstance(entries, list):
+            return False
+        for e in entries:
+            if not isinstance(e, dict) or \
+                    not isinstance(e.get("file"), str) or \
+                    not isinstance(e.get("capacity"), int):
+                return False
+    return True
+
+
 class HostFileShardHandle:
     """Lazy shard handle with the SpillableBatch protocol: ``capacity``
     is known from the manifest (no I/O), ``get()`` reads + verifies +
@@ -253,9 +281,12 @@ class HostFileSession(ShuffleSession):
                 try:
                     with open(os.path.join(self.root, name),
                               encoding="utf-8") as f:
-                        manifests.append(json.load(f))
+                        m = json.load(f)
                 except (OSError, ValueError):
                     continue      # racing writer; re-poll
+                if not valid_manifest(m):
+                    continue      # torn/partial write; not published yet
+                manifests.append(m)
             if len(manifests) >= self.expected_workers:
                 break
             if time.monotonic() >= deadline:

@@ -40,6 +40,7 @@ import socket
 import socketserver
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Set, Tuple
 
 _BACKOFF_CAP_S = 2.0
@@ -113,12 +114,20 @@ class _Handler(socketserver.StreamRequestHandler):
             self.wfile.write(b"ERR\n" if resp is None else resp)
 
 
+class _TCPServer(socketserver.ThreadingTCPServer):
+    # A restarted coordinator must rebind its fixed port immediately
+    # after its predecessor was SIGKILLed; without SO_REUSEADDR the
+    # lingering TIME_WAIT sockets make the bind fail with EADDRINUSE
+    # and failover recovery never comes up.
+    allow_reuse_address = True
+
+
 class RendezvousServer:
     """Threaded TCP rendezvous. ``addr`` is the bound (host, port) —
     pass port 0 to let the OS pick one (tests)."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        srv = self._srv = socketserver.ThreadingTCPServer(
+        srv = self._srv = _TCPServer(
             (host, port), _Handler, bind_and_activate=True)
         srv.daemon_threads = True
         srv.state = _State()                  # type: ignore[attr-defined]
@@ -146,15 +155,21 @@ def _roundtrip(addr: Tuple[str, int], line: str,
 
     ``timeout_s`` bounds the connect AND the response read of each
     attempt; a refused/timed-out attempt backs off deterministically
-    (``backoff_ms * 2^i``, capped) and retries up to ``retries`` extra
-    times before raising :class:`RendezvousUnavailableError`.
+    (``backoff_ms * 2^i``, capped, plus a deterministic jitter derived
+    from the request line — so a fleet of clients retrying through one
+    coordinator outage desynchronizes instead of stampeding in
+    lockstep, without introducing nondeterminism) and retries up to
+    ``retries`` extra times before raising
+    :class:`RendezvousUnavailableError`.
     """
     attempts = max(int(retries), 0) + 1
     last: Optional[BaseException] = None
     for i in range(attempts):
         if i:
-            time.sleep(min(backoff_ms * (2 ** (i - 1)) / 1000.0,
-                           _BACKOFF_CAP_S))
+            base = min(backoff_ms * (2 ** (i - 1)) / 1000.0,
+                       _BACKOFF_CAP_S)
+            jitter = (zlib.crc32(f"{line}|{i}".encode()) % 1000) / 1000.0
+            time.sleep(base * (1.0 + 0.25 * jitter))
         try:
             with socket.create_connection(addr, timeout=timeout_s) as s:
                 s.sendall(line.encode("utf-8"))

@@ -167,6 +167,61 @@ def test_hostfile_corrupt_at_rest_refetches_once(tmp_path):
         w.invalidate()
 
 
+def test_valid_manifest_schema():
+    from spark_rapids_tpu.parallel.transport.hostfile import \
+        valid_manifest
+    good = {"worker": "w0", "num_partitions": 2,
+            "shards": {"0": [{"file": "w0/p00000-0000.shard",
+                              "capacity": 4, "rows": 3}]}}
+    assert valid_manifest(good)
+    assert not valid_manifest(None)
+    assert not valid_manifest([])
+    assert not valid_manifest({})
+    assert not valid_manifest({**good, "worker": 7})
+    assert not valid_manifest({**good, "num_partitions": "2"})
+    assert not valid_manifest({**good, "shards": "torn"})
+    assert not valid_manifest({**good, "shards": {"0": "torn"}})
+    assert not valid_manifest({**good, "shards": {"0": [{"file": 3}]}})
+    assert not valid_manifest(
+        {**good, "shards": {"0": [{"file": "x"}]}})   # no capacity
+
+
+def test_hostfile_torn_manifest_reads_as_unpublished(tmp_path):
+    """Regression (ISSUE 17): a manifest that lands WITHOUT the atomic
+    rename — truncated JSON or a complete JSON document missing the
+    commit() schema — must read as 'not yet published' (fetch keeps
+    polling, then times out ShardLostError). It must never surface as a
+    KeyError/TypeError deep inside fetch_shards."""
+    import json
+    conf = _hostfile_conf(
+        tmp_path, SHUFFLE_TRANSPORT_HOSTFILE_FETCH_TIMEOUT_MS=250)
+    w = HostFileTransport().open(conf, "xtorn", 1, owner=5)
+    w.write_shard(0, _batch([1, 2], [3, 4]))
+    w.commit()
+    mpath = w._manifest_path()
+    with open(mpath, encoding="utf-8") as f:
+        full = f.read()
+    # (a) truncated mid-document: unparseable JSON
+    with open(mpath, "w", encoding="utf-8") as f:
+        f.write(full[: len(full) // 2])
+    r = HostFileTransport().open(conf, "xtorn", 1, owner=5)
+    with pytest.raises(ShardLostError) as ei:
+        r.fetch_shards(0)
+    assert ei.value.fault_owner == 5
+    # (b) parseable JSON but missing the commit() schema
+    with open(mpath, "w", encoding="utf-8") as f:
+        json.dump({"worker": "w0", "shards": "torn"}, f)
+    r = HostFileTransport().open(conf, "xtorn", 1, owner=5)
+    with pytest.raises(ShardLostError):
+        r.fetch_shards(0)
+    # (c) the complete manifest restored: published again, same data
+    with open(mpath, "w", encoding="utf-8") as f:
+        f.write(full)
+    r = HostFileTransport().open(conf, "xtorn", 1, owner=5)
+    assert _rows(r.fetch_shards(0)[0].get()) == [(1, 3), (2, 4)]
+    w.invalidate()
+
+
 def test_hostfile_invalidate_drops_spool(tmp_path):
     conf = _hostfile_conf(tmp_path)
     w = HostFileTransport().open(conf, "xinval", 1, owner=1)
@@ -262,7 +317,8 @@ def parity_dir(tmp_path_factory):
     return str(d)
 
 
-@pytest.mark.parametrize("transport", ["inprocess", "mesh", "hostfile"])
+@pytest.mark.parametrize(
+    "transport", ["inprocess", "mesh", "hostfile", "objectstore"])
 def test_join_agg_bit_identical_across_transports(transport, parity_dir,
                                                   tmp_path):
     from spark_rapids_tpu.api.dataframe import TpuSession
