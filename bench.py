@@ -304,7 +304,9 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
     tm_base = _queries_total(_tm.render_text()) if _tm.enabled() else None
     c0 = _pc.counters()
     from spark_rapids_tpu.parallel import qos as _qos
+    from spark_rapids_tpu.parallel import scheduler as _sc
     q0c = _qos.counters()
+    s0c = _sc.counters()
     lock = threading.Lock()
     lat: list = []
     idx = {"i": 0}
@@ -313,7 +315,10 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
     def client(k):
         # Each client is a distinct serving tenant: the per-tenant
         # plan-cache counters (parallel/qos/) attribute every hit/miss
-        # even with the QoS scheduler off.
+        # even with the QoS scheduler off. Obedient-client contract
+        # (ISSUE 18): rejections with a retry_after_ms hint back off
+        # and resubmit through collect_with_retry (deterministic
+        # per-client jitter, seed=k) instead of counting as errors.
         tenant = f"client{k}"
         while True:
             with lock:
@@ -323,7 +328,8 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
                 idx["i"] = i + 1
             q0 = time.perf_counter()
             try:
-                shapes[i % len(shapes)](s, i).collect(tenant=tenant)
+                shapes[i % len(shapes)](s, i).collect_with_retry(
+                    tenant=tenant, seed=k)
             except Exception:
                 with lock:
                     errors[0] += 1
@@ -386,8 +392,11 @@ def _sustained_probe(tpch_dir: str, total: int, clients: int) -> dict:
         return (time.perf_counter() - t) / n
     on_s = serial(True, 6, 500)
     off_s = serial(False, 6, 600)
+    s1c = _sc.counters()
     return {
         "queries": total, "clients": clients, "errors": errors[0],
+        "client_retries": int(s1c.get("clientRetries", 0)
+                              - s0c.get("clientRetries", 0)),
         "max_concurrent": 4,
         "warmup_s": round(warmup_s, 3),
         "wall_s": round(wall, 3),

@@ -374,6 +374,29 @@ class DataFrame:
         return self._physical().collect(timeout_ms=timeout_ms,
                                         priority=priority, tenant=tenant)
 
+    def collect_with_retry(self, timeout_ms: Optional[float] = None,
+                           priority: Optional[str] = None,
+                           tenant: Optional[str] = None,
+                           max_attempts: Optional[int] = None,
+                           max_backoff_ms: Optional[float] = None,
+                           seed: int = 0) -> List[tuple]:
+        """:meth:`collect` behind the obedient-client backpressure loop
+        (parallel/scheduler.collect_with_retry): a
+        ``QueryRejectedError`` carrying a ``retry_after_ms`` hint backs
+        off for the hinted interval (deterministic per-``seed`` jitter,
+        capped at ``client.retry.maxBackoffMs``) and resubmits, up to
+        ``client.retry.maxAttempts`` attempts; hintless rejections
+        re-raise immediately. This is the call a sustained serving
+        client should make — a herd of them converges onto the
+        scheduler's observed service rate instead of hammering a full
+        queue (bench.py's sustained probe does exactly this)."""
+        from spark_rapids_tpu.parallel import scheduler as SC
+        return SC.collect_with_retry(
+            lambda: self.collect(timeout_ms=timeout_ms,
+                                 priority=priority, tenant=tenant),
+            conf=self._session.conf, max_attempts=max_attempts,
+            max_backoff_ms=max_backoff_ms, seed=seed)
+
     def submit(self, timeout_ms: Optional[float] = None,
                priority: Optional[str] = None,
                tenant: Optional[str] = None):

@@ -761,6 +761,87 @@ QOS_DEADLINE_SLACK = conf(
     "admission test (>1.0 rejects earlier — estimates are optimistic "
     "about queueing; <1.0 admits optimistically).").double(1.0)
 
+PREEMPTION_ENABLED = conf(
+    "spark.rapids.sql.scheduler.preemption.enabled").doc(
+    "Class-aware device preemption (the overload survival plane): when "
+    "a higher-priority query queues for the TPU semaphore behind a "
+    "running lower-class query, the victim is asked to suspend at its "
+    "next partition boundary — it spills its live catalog buffers "
+    "through the existing memory ladder, releases the device permit, "
+    "and resumes through the stage DAG after the preemptor drains "
+    "(durable stage outputs are kept, so only unfinished work re-runs; "
+    "results stay byte-identical for victim and preemptor). Default "
+    "off: the device gate is the flat class-blind semaphore, "
+    "byte-for-byte today's behavior. Counters preemptions/preemptedMs/"
+    "resumedStages.").boolean(False)
+
+PREEMPTION_MAX_PER_QUERY = conf(
+    "spark.rapids.sql.scheduler.preemption.maxPerQuery").doc(
+    "Upper bound on how many times one query may be preempted; past it "
+    "the query ignores further preemption requests and runs to "
+    "completion (livelock guard for a sustained interactive storm)."
+).integer(4)
+
+PREEMPTION_SPILL_ENABLED = conf(
+    "spark.rapids.sql.scheduler.preemption.spill.enabled").doc(
+    "Whether a preempted query spills its spillable device buffers to "
+    "host while suspended (frees HBM for the preemptor). Off = suspend "
+    "only releases the device permit and keeps buffers resident."
+).boolean(True)
+
+PRESSURE_ENABLED = conf(
+    "spark.rapids.sql.scheduler.pressure.enabled").doc(
+    "Memory-pressure shedding: each collect publishes a pressure score "
+    "derived from its catalog's device/host/disk watermarks "
+    "(srt_pressure_score; workers piggyback it on CBEAT heartbeats), "
+    "the cluster coordinator demotes pressured workers below the "
+    "steal-delay placement preference so they shed new stages instead "
+    "of spilling, and sustained device pressure flips admission into "
+    "brownout mode. Default off: no score is consulted anywhere."
+).boolean(False)
+
+PRESSURE_SHED_SCORE = conf(
+    "spark.rapids.sql.scheduler.pressure.shedScore").doc(
+    "Pressure score at or above which the coordinator demotes a worker "
+    "in CPOLL placement (it loses steal-delay reservations and only "
+    "receives a stage when every unpressured worker is busy or the "
+    "reservation window expired). Scores are in [0, ~1.35]; the device "
+    "fraction dominates.").double(0.75)
+
+PRESSURE_BROWNOUT_SCORE = conf(
+    "spark.rapids.sql.scheduler.pressure.brownout.enterScore").doc(
+    "Device-pressure score at or above which (sustained for "
+    "brownout.sustainMs) admission enters brownout: background-class "
+    "queries are rejected with kind 'brownout' and a retry-after hint "
+    "while interactive/batch admit normally — load is shed BEFORE the "
+    "OOM ladders engage.").double(0.9)
+
+PRESSURE_BROWNOUT_EXIT_SCORE = conf(
+    "spark.rapids.sql.scheduler.pressure.brownout.exitScore").doc(
+    "Pressure score below which brownout mode exits (hysteresis: must "
+    "be below brownout.enterScore or brownout flaps).").double(0.7)
+
+PRESSURE_BROWNOUT_SUSTAIN_MS = conf(
+    "spark.rapids.sql.scheduler.pressure.brownout.sustainMs").doc(
+    "How long the pressure score must stay at or above "
+    "brownout.enterScore before admission browns out — one transient "
+    "spike (a single large partition) must not shed a whole class."
+).integer(200)
+
+CLIENT_RETRY_MAX_ATTEMPTS = conf(
+    "spark.rapids.sql.client.retry.maxAttempts").doc(
+    "Default attempt budget for DataFrame.collect_with_retry: total "
+    "admission attempts before the last QueryRejectedError propagates. "
+    "Each retry honors the rejection's retry_after_ms hint with capped "
+    "deterministic-jitter backoff (counter clientRetries / "
+    "srt_client_retries_total).").integer(5)
+
+CLIENT_RETRY_MAX_BACKOFF_MS = conf(
+    "spark.rapids.sql.client.retry.maxBackoffMs").doc(
+    "Cap on one collect_with_retry backoff sleep, applied after the "
+    "retry_after_ms hint and the deterministic jitter (a rejection "
+    "storm must converge, not sleep unboundedly).").integer(10000)
+
 TEST_FAULTS_QUERY_TAG = conf(
     "spark.rapids.sql.test.faults.queryTag").doc(
     "Explicit fault tag for query-scoped chaos (kind@site/query=N "

@@ -163,6 +163,24 @@ class QueryCancelledError(RuntimeError):
         self.reason = reason
 
 
+class QueryPreemptedError(RuntimeError):
+    """Control-flow only: the query was asked to yield the device to a
+    higher-priority class and unwound at a partition boundary. The
+    planner's ladder catches it, spills the query's catalog, waits for
+    the preemptor to drain, and resumes on the SAME context — durable
+    stage outputs make the suspension invisible in the results. Like
+    cancellation, the message carries NO transient/OOM marker: no other
+    retry rung may consume a preemption."""
+
+    def __init__(self, query_id: int, preemptor: Optional[str] = None):
+        super().__init__(
+            f"PREEMPTED: query {query_id} yielded the device to a "
+            f"{preemptor or 'higher-priority'} query "
+            "(spark.rapids.sql.scheduler.preemption.*)")
+        self.query_id = query_id
+        self.preemptor = preemptor
+
+
 class QueryToken:
     """Per-query cooperative cancellation/deadline handle, issued by the
     QueryManager at admission and registered thread-locally on every
@@ -173,12 +191,22 @@ class QueryToken:
     pipeline ``_take``, injected stalls) can wake on it; ``reason`` is
     set before the event so the unwinding error names why. The deadline
     is enforced by the scheduler's timer arm (it sets the same event),
-    so checkpoints only ever test one flag."""
+    so checkpoints only ever test one flag.
 
-    __slots__ = ("query_id", "fault_tag", "cancel", "reason", "tenant")
+    ``preempt`` is the overload survival plane's second, gentler signal
+    (scheduler.preemption.enabled): set by the class-ranked device gate
+    when a higher-priority query is queued behind this one. Unlike
+    cancel it is only honored at partition boundaries
+    (:func:`check_preempted`) and the query RESUMES afterwards — it
+    never changes results, only when the device is held."""
+
+    __slots__ = ("query_id", "fault_tag", "cancel", "reason", "tenant",
+                 "qos_class", "preempt", "preemptor_class",
+                 "preempt_enabled")
 
     def __init__(self, query_id: int, fault_tag: Optional[int] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 qos_class: Optional[str] = None):
         self.query_id = query_id
         # The tag query-scoped fault entries (kind@site/query=N) match.
         self.fault_tag = fault_tag if fault_tag is not None else query_id
@@ -187,6 +215,15 @@ class QueryToken:
         # Serving-tier identity (parallel/qos/): owner attribution for
         # per-tenant quotas and plan-cache stats. None = untagged.
         self.tenant = tenant
+        # Priority class (parallel/qos/) — the class-ranked device gate
+        # orders acquisition and picks preemption victims by it. None =
+        # FIFO admission (ranks as the default class).
+        self.qos_class = qos_class
+        self.preempt = threading.Event()
+        self.preemptor_class: Optional[str] = None
+        # Cleared by the planner once preemption.maxPerQuery is spent:
+        # further requests are ignored and the query runs to completion.
+        self.preempt_enabled = True
 
     def request_cancel(self, reason: str = "cancelled") -> None:
         self.reason = reason
@@ -197,6 +234,20 @@ class QueryToken:
 
     def error(self) -> QueryCancelledError:
         return QueryCancelledError(self.query_id, self.reason)
+
+    def request_preempt(self, preemptor_class: Optional[str] = None) -> None:
+        """Ask this query to yield the device at its next partition
+        boundary (the class-ranked gate calls this; honoring it is
+        cooperative and bounded by preemption.maxPerQuery)."""
+        self.preemptor_class = preemptor_class
+        self.preempt.set()
+
+    def preempt_requested(self) -> bool:
+        return self.preempt_enabled and self.preempt.is_set()
+
+    def clear_preempt(self) -> None:
+        self.preempt.clear()
+        self.preemptor_class = None
 
 
 def set_query_token(token: Optional[QueryToken]) -> None:
@@ -219,6 +270,21 @@ def check_cancelled() -> None:
     tok = getattr(_TL, "query", None)
     if tok is not None and tok.cancel.is_set():
         raise tok.error()
+
+
+def check_preempted() -> None:
+    """Partition-boundary preemption checkpoint: raise
+    :class:`QueryPreemptedError` when the class-ranked device gate asked
+    the calling thread's query to yield. Separate from
+    :func:`check_cancelled` on purpose — preemption is only honored
+    where suspending is safe (between partitions, where every live
+    intermediate is catalog-registered data at rest), never inside the
+    deep dispatch funnels. One thread-local load + two attribute tests
+    when a token is registered; a no-op whenever preemption is off
+    (the gate never sets the event)."""
+    tok = getattr(_TL, "query", None)
+    if tok is not None and tok.preempt_enabled and tok.preempt.is_set():
+        raise QueryPreemptedError(tok.query_id, tok.preemptor_class)
 
 
 def current_query_id() -> Optional[int]:

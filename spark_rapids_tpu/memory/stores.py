@@ -20,6 +20,7 @@ import heapq
 import itertools
 import logging
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 _LOG = logging.getLogger("spark_rapids_tpu.memory")
@@ -510,6 +511,47 @@ class SpillableBatch:
 _GLOBAL_SEM: Optional["TpuSemaphore"] = None
 _GLOBAL_SEM_LOCK = threading.Lock()
 
+# Class-aware device preemption gate
+# (spark.rapids.sql.scheduler.preemption.enabled): process-global like
+# the metrics/wire regimes — last collect's conf wins. False keeps the
+# acquire path byte-for-byte the flat class-blind semaphore.
+_PREEMPT_ENABLED = False
+
+
+def preemption_configure(conf) -> None:
+    """Adopt this query's preemption gate setting (called from the
+    dispatch funnel before the semaphore is touched)."""
+    global _PREEMPT_ENABLED
+    from spark_rapids_tpu import config as C
+    _PREEMPT_ENABLED = bool(conf.get(C.PREEMPTION_ENABLED))
+
+
+def preemption_enabled() -> bool:
+    return _PREEMPT_ENABLED
+
+
+def _class_rank(token) -> int:
+    """The token's priority rank for the device gate (lower = better).
+    Untagged/FIFO queries rank as the default class, so preemption
+    only ever engages when somebody actually declared a class."""
+    from spark_rapids_tpu.parallel.qos.policy import (CLASS_RANK,
+                                                      DEFAULT_CLASS)
+    cls = getattr(token, "qos_class", None) or DEFAULT_CLASS
+    return CLASS_RANK.get(cls, CLASS_RANK[DEFAULT_CLASS])
+
+
+def pressure_score(catalog: Optional["BufferCatalog"]) -> float:
+    """Memory-pressure score of one catalog: the device watermark
+    fraction dominates (it is what OOMs), host and disk occupancy add
+    smaller terms so a ladder already spilling reads hotter than one
+    merely full. Range [0, ~1.35]; each tier fraction clamps at 1."""
+    if catalog is None:
+        return 0.0
+    dev = min(catalog.device_bytes / max(catalog.device_budget, 1), 1.0)
+    host = min(catalog.host_bytes / max(catalog.host_budget, 1), 1.0)
+    disk = min(catalog.disk_bytes / max(catalog.host_budget, 1), 1.0)
+    return round(dev + 0.25 * host + 0.1 * disk, 4)
+
 
 def get_tpu_semaphore(permits: int) -> "TpuSemaphore":
     """THE process-wide admission semaphore, sized by the FIRST
@@ -529,11 +571,29 @@ class TpuSemaphore:
     """Task-admission semaphore (GpuSemaphore.scala:101):
     ``spark.rapids.sql.concurrentTpuTasks`` tasks may issue device work at
     once; auto-release via context manager replaces the task-completion
-    listener."""
+    listener.
+
+    With ``scheduler.preemption.enabled`` the same permits become a
+    CLASS-RANKED gate: tokened acquisitions queue in (class rank,
+    arrival) order, only the head waiter takes a permit, and a head
+    waiter that outranks a running holder asks the WORST-ranked holder
+    to yield at its next partition boundary
+    (``QueryToken.request_preempt`` — cooperative, so live device state
+    is always catalog-registered data at rest when the permit comes
+    back). Victims re-enter through :meth:`wait_resume`, which queues
+    at their own rank — a preempted background query resumes exactly
+    when the interactive burst above it has drained. Disabled (the
+    default), every acquire takes the flat-semaphore path unchanged."""
 
     def __init__(self, permits: int = 2):
         self._sem = threading.Semaphore(permits)
         self.permits = permits
+        # Classed-gate state (only touched when preemption is enabled):
+        self._gate_lock = threading.Lock()
+        self._seq = 0
+        self._waiters: List[list] = []        # [rank, seq, token]
+        self._holders: Dict[int, list] = {}   # id(token) -> [tok, rank, n]
+        self.preempt_requests = 0
 
     def __enter__(self):
         # Cancellation-aware: a query cancelled/deadlined while QUEUED
@@ -549,12 +609,105 @@ class TpuSemaphore:
             if tok is None:
                 self._sem.acquire()
                 return self
+            if _PREEMPT_ENABLED:
+                self._acquire_classed(tok)
+                return self
             while not self._sem.acquire(timeout=0.05):
                 if tok.cancelled():
                     raise tok.error()
             return self
 
+    # -- class-ranked gate (preemption.enabled only) -------------------------
+    def _enqueue(self, tok) -> list:
+        with self._gate_lock:
+            self._seq += 1
+            w = [_class_rank(tok), self._seq, tok]
+            self._waiters.append(w)
+            return w
+
+    def _head(self, w: list) -> bool:
+        """Whether ``w`` is the best-ranked waiter (class rank first,
+        arrival order within a class) — only the head takes a permit, so
+        grants happen in priority order."""
+        return min(self._waiters, key=lambda x: (x[0], x[1])) is w
+
+    def _request_preempt_locked(self, rank: int) -> None:
+        """A head waiter of rank ``rank`` found every permit held: ask
+        the WORST strictly-lower-class holder (highest rank number) to
+        yield. Idempotent per victim — the event is level-triggered."""
+        victim = None
+        for tok, hrank, _n in self._holders.values():
+            if hrank > rank and tok.preempt_enabled \
+                    and not tok.preempt.is_set():
+                if victim is None or hrank > victim[1]:
+                    victim = (tok, hrank)
+        if victim is not None:
+            from spark_rapids_tpu.parallel.qos.policy import CLASSES
+            self.preempt_requests += 1
+            victim[0].request_preempt(CLASSES[rank]
+                                      if 0 <= rank < len(CLASSES)
+                                      else None)
+
+    def _acquire_classed(self, tok) -> None:
+        w = self._enqueue(tok)
+        rank = w[0]
+        try:
+            while True:
+                if tok.cancelled():
+                    raise tok.error()
+                with self._gate_lock:
+                    if self._head(w):
+                        if self._sem.acquire(blocking=False):
+                            self._waiters.remove(w)
+                            h = self._holders.get(id(tok))
+                            if h is None:
+                                self._holders[id(tok)] = [tok, rank, 1]
+                            else:
+                                h[2] += 1
+                            return
+                        # Head of the line, no permit: preempt the
+                        # worst-ranked running holder (if any is
+                        # strictly below this class).
+                        self._request_preempt_locked(rank)
+                time.sleep(0.005)
+        except BaseException:
+            with self._gate_lock:
+                if w in self._waiters:
+                    self._waiters.remove(w)
+            raise
+
+    def wait_resume(self, tok, cancel=None) -> None:
+        """Block a preempted query until the gate would grant its class
+        a permit again (the preemptor — and every other higher-ranked
+        waiter — has drained), WITHOUT taking the permit: the caller's
+        re-collect re-acquires normally. Acquire-then-release keeps the
+        resume ordered through the same ranked queue."""
+        if not _PREEMPT_ENABLED:
+            return
+        self._acquire_classed(tok)
+        self.release_classed(tok)
+
+    def release_classed(self, tok) -> None:
+        with self._gate_lock:
+            h = self._holders.get(id(tok))
+            if h is not None:
+                h[2] -= 1
+                if h[2] <= 0:
+                    self._holders.pop(id(tok), None)
+        self._sem.release()
+
     def __exit__(self, *exc):
+        from spark_rapids_tpu import faults
+        tok = faults.get_query_token()
+        if tok is not None and _PREEMPT_ENABLED:
+            self.release_classed(tok)
+            return False
+        with self._gate_lock:
+            # A holder registered under the classed gate may release
+            # after a mid-flight regime flip (mixed confs): keep the
+            # holder table honest either way.
+            if tok is not None:
+                self._holders.pop(id(tok), None)
         self._sem.release()
         return False
 
@@ -563,3 +716,11 @@ class TpuSemaphore:
 
     def release(self):
         self._sem.release()
+
+    @property
+    def holders(self) -> List[tuple]:
+        """(query_id, class rank) of current classed-gate holders
+        (tests/diagnostics)."""
+        with self._gate_lock:
+            return [(t.query_id, r) for t, r, _n in
+                    self._holders.values()]

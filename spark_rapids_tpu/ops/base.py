@@ -511,6 +511,7 @@ class Exec:
             from spark_rapids_tpu import config as C, monitoring
             from spark_rapids_tpu.columnar import wire
             from spark_rapids_tpu.columnar.host import download_batches
+            from spark_rapids_tpu.memory import stores
             from spark_rapids_tpu.memory.stores import get_tpu_semaphore
             # Adopt this query's wire codec selection (process-global,
             # spark.rapids.sql.wire.codec) before any upload happens —
@@ -524,6 +525,7 @@ class Exec:
             monitoring.maybe_configure(ctx.conf)
             telemetry.maybe_configure(ctx.conf)
             native.maybe_configure(ctx.conf)
+            stores.preemption_configure(ctx.conf)
             # Task admission (GpuSemaphore.scala:74-87): at most
             # concurrentTpuTasks collects issue device work at once, so
             # concurrent queries can't oversubscribe HBM.
@@ -569,10 +571,13 @@ class Exec:
                             pipe = PL.open_pipeline(ctx, self, nparts)
                             try:
                                 for p in range(nparts):
-                                    # Per-partition cancellation
-                                    # checkpoint (the deep funnels check
-                                    # too, via fault_point).
+                                    # Per-partition cancellation +
+                                    # preemption checkpoint (the deep
+                                    # funnels check cancellation too,
+                                    # via fault_point; preemption only
+                                    # ever fires at this boundary).
                                     faults.check_cancelled()
+                                    faults.check_preempted()
                                     # consume() waits for p's host half
                                     # then returns the device stream
                                     # verbatim, so the serial path keeps
@@ -601,6 +606,10 @@ class Exec:
                             pipe = PL.open_pipeline(ctx, self, nparts)
                             try:
                                 for p in range(nparts):
+                                    # Same partition-boundary preemption
+                                    # checkpoint as the serial loop (the
+                                    # watchdog handles cancellation).
+                                    faults.check_preempted()
                                     with monitoring.span(
                                             "partition", "device-compute",
                                             args={"partition": p,
@@ -638,6 +647,16 @@ class Exec:
                     "srt_collect_ms",
                     (time.perf_counter() - t0_collect) * 1e3)
                 cat = ctx._catalog
+                if cat is not None:
+                    # Memory-pressure plane: one scalar score per
+                    # collect teardown feeds the admission brownout
+                    # state machine and (via the worker heartbeat) the
+                    # coordinator's shed-aware placement.
+                    score = stores.pressure_score(cat)
+                    if telemetry.enabled():
+                        telemetry.set_gauge("srt_pressure_score", score)
+                    from spark_rapids_tpu.parallel import scheduler as SC
+                    SC.note_pressure(score, ctx.conf)
                 if cat is not None and telemetry.enabled():
                     telemetry.set_gauge("srt_memory_bytes",
                                         cat.device_bytes, tier="device")

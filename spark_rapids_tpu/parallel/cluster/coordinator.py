@@ -364,13 +364,17 @@ class _StageTask:
 
 
 class _WorkerInfo:
-    __slots__ = ("wid", "last_seen", "alive", "completed")
+    __slots__ = ("wid", "last_seen", "alive", "completed", "pressure")
 
     def __init__(self, wid: str, now: float):
         self.wid = wid
         self.last_seen = now
         self.alive = True
         self.completed = 0
+        # Latest memory-pressure score off this worker's CBEAT
+        # telemetry piggyback (0.0 until it reports one): the signal
+        # shed-aware placement demotes loaded workers on.
+        self.pressure = 0.0
 
 
 class QueryRun:
@@ -395,6 +399,11 @@ class QueryRun:
                                0)
         self.steal_delay_s = max(
             int(conf.get(C.CLUSTER_STEAL_DELAY_MS)), 0) / 1000.0
+        # Memory-pressure shedding (scheduler.pressure.*): a worker at
+        # or past shedScore is demoted below steal-delay preference so
+        # it sheds NEW stages instead of spilling under more of them.
+        self.pressure_enabled = bool(conf.get(C.PRESSURE_ENABLED))
+        self.shed_score = float(conf.get(C.PRESSURE_SHED_SCORE))
         self.error: Optional[BaseException] = None
         self._ctx = None
         self._root = None       # driver's unpickled plan root (submit)
@@ -671,8 +680,23 @@ class QueryRun:
         def owned(t: _StageTask, w: str) -> int:
             return 1 if _hrw_owner(alive, t.sid) == w else 0
 
-        def rank(t: _StageTask, w: str) -> Tuple[int, int]:
-            return (score(t, w), owned(t, w))
+        def unpressured(w: str) -> int:
+            """Shed-aware demotion tier (scheduler.pressure.*): an
+            unpressured worker outranks a pressured one for BOTH the
+            steal-delay reservation and the pick itself, so a loaded
+            worker sheds new stages to its peers instead of spilling
+            under them. All-pressured (or the gate off) collapses the
+            tier to a constant — placement is exactly the old
+            (locality, affinity) order."""
+            if not self.pressure_enabled:
+                return 1
+            info = self.co.workers.get(w)
+            if info is None or info.pressure < self.shed_score:
+                return 1
+            return 0
+
+        def rank(t: _StageTask, w: str) -> Tuple[int, int, int]:
+            return (unpressured(w), score(t, w), owned(t, w))
 
         now = time.monotonic()
 
@@ -685,6 +709,17 @@ class QueryRun:
 
         ready = [t for t in ready if eligible(t)]
         if not ready:
+            if self.pressure_enabled and not unpressured(wid):
+                # This poll was shed purely by pressure demotion (a
+                # less-loaded peer holds the reservation): visible in
+                # telemetry + the event log, like every other rung.
+                from spark_rapids_tpu import monitoring
+                from spark_rapids_tpu.monitoring import telemetry
+                telemetry.inc("srt_pressure_sheds")
+                monitoring.instant(
+                    "pressure-shed", "recovery",
+                    args={"worker": wid,
+                          "pressure": self.co.workers[wid].pressure})
             return None         # reserved for others — poll again shortly
         best = max(ready, key=lambda t: rank(t, wid) + (-t.sid,))
         best.status = _RUNNING
@@ -992,8 +1027,20 @@ class ClusterCoordinator:
                 # Old-format beats (2 parts) stay valid forever.
                 try:
                     from spark_rapids_tpu.monitoring import telemetry
-                    telemetry.fleet_update(parts[1], json.loads(
-                        base64.b64decode(parts[2]).decode()))
+                    blob = json.loads(
+                        base64.b64decode(parts[2]).decode())
+                    telemetry.fleet_update(parts[1], blob)
+                    # Memory-pressure piggyback: the worker's catalog
+                    # watermark score rides the same heartbeat; CPOLL
+                    # placement demotes pressured workers below
+                    # steal-delay preference (_pick_locked).
+                    score = blob.get("series", {}).get(
+                        "srt_pressure_score|")
+                    if score is not None:
+                        with self._lock:
+                            w = self.workers.get(parts[1])
+                            if w is not None:
+                                w.pressure = float(score)
                 except Exception:
                     _LOG.warning("cluster: bad CBEAT telemetry blob "
                                  "from %s", parts[1], exc_info=True)

@@ -188,6 +188,18 @@ class Worker:
                 # the driver's fleet view with a worker label.
                 from spark_rapids_tpu.monitoring import telemetry
                 if telemetry.enabled():
+                    # Memory-pressure score first, so every beat carries
+                    # THIS worker's current catalog watermarks (the max
+                    # over loaded queries: one hot query is enough to
+                    # shed placement here).
+                    from spark_rapids_tpu.memory import stores
+                    score = 0.0
+                    for st in list(self.queries.values()):
+                        cat = getattr(st.ctx, "_catalog", None)
+                        if cat is not None:
+                            score = max(score,
+                                        stores.pressure_score(cat))
+                    telemetry.set_gauge("srt_pressure_score", score)
                     blob = base64.b64encode(json.dumps(
                         telemetry.export_cluster_blob(),
                         default=str).encode()).decode()

@@ -48,7 +48,15 @@ class QosPolicy:
     def deadline_rejects(self, conf, cost_ms: Optional[float],
                          deadline_ms: Optional[float]) -> Optional[str]:
         """The rejection reason when the cost estimate cannot meet the
-        deadline, else None (admit)."""
+        deadline, else None (admit).
+
+        The manager splits the resulting rejection's ``retry_after_ms``
+        on WHY it was unmeetable: when only the load-scaled slack broke
+        the deadline (``cost_ms <= deadline_ms < cost_ms * slack``) a
+        resubmission against a drained queue can succeed, so the hint
+        is carried; when the RAW estimate already exceeds the deadline
+        the hint is None — retrying as-is can never help, and
+        ``collect_with_retry`` re-raises immediately."""
         from spark_rapids_tpu import config as C
         if deadline_ms is None or deadline_ms <= 0 or cost_ms is None:
             return None

@@ -128,15 +128,18 @@ def record_plan_cache(ctx, hit: bool) -> None:
 
 class QueryRejectedError(RuntimeError):
     """Load shed or policy rejection. Deliberately NOT a transient
-    error (no retry marker): the caller — a serving tier, a test —
-    decides whether to resubmit, guided by the structured fields:
+    error (no retry marker): the caller — a serving tier, a test, or
+    :func:`collect_with_retry` — decides whether to resubmit, guided by
+    the structured fields:
 
     - ``kind``: ``queue-full`` | ``admission-timeout`` |
-      ``tenant-quota`` | ``deadline-unmeetable``
+      ``tenant-quota`` | ``deadline-unmeetable`` | ``brownout``
     - ``queue_depth``: run-queue occupancy snapshot at rejection
     - ``retry_after_ms``: when resubmitting could plausibly succeed
-      (observed-service-time estimate); None when retrying as-is can
-      never help (an unmeetable deadline)."""
+      (observed-service-time estimate scaled by live queue depth —
+      every load-type rejection carries it); None only when retrying
+      as-is can never help (a deadline the raw cost estimate already
+      exceeds)."""
 
     def __init__(self, reason: str, kind: str = "rejected",
                  queue_depth: Optional[int] = None,
@@ -218,6 +221,21 @@ class QueryManager:
         # retry_after_ms hint on rejections — attribution only, never
         # a scheduling input on the FIFO path).
         self._service_ewma_ms: Optional[float] = None
+        # Brownout state (scheduler.pressure.*): driven by
+        # note_pressure() observations from the dispatch funnel.
+        self._pressure_score = 0.0
+        self._pressure_high_since: Optional[float] = None
+        self.brownout_active = False
+        # Set (under this manager's lock) when a conf-change resize
+        # replaced this manager: late calls on a stale reference follow
+        # the chain so a ticket can never land in a retired manager.
+        self._successor: Optional["QueryManager"] = None
+
+    def _current(self) -> "QueryManager":
+        m = self
+        while m._successor is not None:
+            m = m._successor
+        return m
 
     # -- admission -----------------------------------------------------------
     def admit(self, conf=None,
@@ -236,6 +254,13 @@ class QueryManager:
         ever runs. ``priority``/``tenant``/``cost_ms``/``deadline_ms``
         feed the QoS policy; on the FIFO path only ``tenant`` is kept
         (as pure attribution for per-tenant stats)."""
+        if self._successor is not None:
+            # A conf-change resize retired this manager while the
+            # caller still held its reference: every new ticket lands
+            # in the live manager, never a retired one.
+            return self._current().admit(
+                conf, cancel=cancel, priority=priority, tenant=tenant,
+                cost_ms=cost_ms, deadline_ms=deadline_ms)
         if self._qos is not None:
             return self._admit_qos(conf, cancel, priority, tenant,
                                    cost_ms, deadline_ms)
@@ -252,10 +277,13 @@ class QueryManager:
         me: Optional[threading.Event] = None
         t0 = time.perf_counter()
         with self._lock:
-            if self._slots_free > 0 and not self._waiters:
+            if self._successor is not None:
+                pass            # retired between the entry check and the
+                                # lock: redirect below, never enqueue here
+            elif self._slots_free > 0 and not self._waiters:
                 self._slots_free -= 1
                 return self._issue(tag, 0.0, cancel, tenant=tnt)
-            if len(self._waiters) >= self.queue_depth:
+            elif len(self._waiters) >= self.queue_depth:
                 _record("rejected")
                 _record("rejected.queue-full")
                 depth = len(self._waiters)
@@ -269,8 +297,13 @@ class QueryManager:
                     f"{self.max_concurrent} running)",
                     kind="queue-full", queue_depth=depth,
                     retry_after_ms=hint)
-            me = threading.Event()
-            self._waiters.append(me)
+            else:
+                me = threading.Event()
+                self._waiters.append(me)
+        if me is None:
+            return self._current().admit(
+                conf, cancel=cancel, priority=priority, tenant=tenant,
+                cost_ms=cost_ms, deadline_ms=deadline_ms)
         deadline = t0 + self.admission_timeout_ms / 1000.0
         while True:
             remaining = deadline - time.perf_counter()
@@ -355,31 +388,58 @@ class QueryManager:
         entry = None
         t0 = time.perf_counter()
         with self._lock:
-            if conf is not None:
-                reason = qos.deadline_rejects(conf, cost_ms, deadline_ms)
-                if reason is not None:
-                    # Retrying the same query with the same deadline
-                    # can never help: no retry-after hint.
-                    reject("deadline-unmeetable", reason,
-                           len(qos.queue), None)
-                reason = qos.tenant_rejects(
-                    conf, tnt, list(self._active.values()))
-                if reason is not None:
-                    reject("tenant-quota", reason, len(qos.queue),
+            if self._successor is not None:
+                # Retired between the entry check and the lock:
+                # redirect below, never enqueue here.
+                me = None
+            else:
+                if conf is not None:
+                    reason = qos.deadline_rejects(conf, cost_ms,
+                                                  deadline_ms)
+                    if reason is not None:
+                        # Retrying as-is can never help when the RAW
+                        # cost estimate already exceeds the deadline —
+                        # but when only the load-scaled slack made it
+                        # unmeetable, a later resubmission against a
+                        # drained queue can succeed: carry the hint.
+                        hopeless = (cost_ms is None or not deadline_ms
+                                    or cost_ms > deadline_ms)
+                        reject("deadline-unmeetable", reason,
+                               len(qos.queue),
+                               None if hopeless
+                               else self._retry_hint_locked())
+                    reason = qos.tenant_rejects(
+                        conf, tnt, list(self._active.values()))
+                    if reason is not None:
+                        reject("tenant-quota", reason, len(qos.queue),
+                               self._retry_hint_locked())
+                if self.brownout_active and qcls == "background":
+                    # Memory-pressure brownout (scheduler.pressure.*):
+                    # sustained device pressure sheds background load
+                    # with a retry hint BEFORE the OOM ladders engage,
+                    # while interactive/batch still admit.
+                    reject("brownout",
+                           f"brownout: sustained device pressure "
+                           f"{self._pressure_score:.2f}, background "
+                           f"load shed", len(qos.queue),
                            self._retry_hint_locked())
-            if self._slots_free > 0 and len(qos.queue) == 0:
-                self._slots_free -= 1
+                if self._slots_free > 0 and len(qos.queue) == 0:
+                    self._slots_free -= 1
+                    qos.quotas.reserve(tnt)
+                    return self._issue(tag, 0.0, cancel, qos_class=qcls,
+                                       tenant=tnt, cost_ms=cost_ms)
+                if len(qos.queue) >= self.queue_depth:
+                    reject("queue-full",
+                           f"run queue full ({len(qos.queue)} queued, "
+                           f"{self.max_concurrent} running)",
+                           len(qos.queue), self._retry_hint_locked())
+                me = threading.Event()
+                entry = qos.queue.push(qcls, cost_ms, me, tnt)
                 qos.quotas.reserve(tnt)
-                return self._issue(tag, 0.0, cancel, qos_class=qcls,
-                                   tenant=tnt, cost_ms=cost_ms)
-            if len(qos.queue) >= self.queue_depth:
-                reject("queue-full",
-                       f"run queue full ({len(qos.queue)} queued, "
-                       f"{self.max_concurrent} running)",
-                       len(qos.queue), self._retry_hint_locked())
-            me = threading.Event()
-            entry = qos.queue.push(qcls, cost_ms, me, tnt)
-            qos.quotas.reserve(tnt)
+        if me is None:
+            return self._current().admit(
+                conf, cancel=cancel, priority=priority, tenant=tenant,
+                cost_ms=cost_ms, deadline_ms=deadline_ms)
         deadline = t0 + self.admission_timeout_ms / 1000.0
         while True:
             remaining = deadline - time.perf_counter()
@@ -420,7 +480,8 @@ class QueryManager:
                cost_ms: Optional[float] = None) -> QueryTicket:
         """Build the admitted ticket (caller holds the lock / the slot)."""
         self._next_id += 1
-        token = faults.QueryToken(self._next_id, tag, tenant=tenant)
+        token = faults.QueryToken(self._next_id, tag, tenant=tenant,
+                                  qos_class=qos_class)
         if cancel is not None:
             # The handle pre-created the cancel event (so cancel() works
             # while still queued); the token adopts it.
@@ -496,9 +557,56 @@ class QueryManager:
         waves = (1 + queued) / max(self.max_concurrent, 1)
         return round(max(50.0, base * waves), 1)
 
+    def note_pressure(self, score: float, conf=None) -> None:
+        """Brownout state machine (scheduler.pressure.*): every dispatch
+        funnel reports its catalog's pressure score here on teardown of a
+        device section. Pressure sustained above the enter threshold for
+        ``brownout.sustainMs`` flips brownout ON (background admissions
+        shed with retry hints); dropping below the exit threshold flips
+        it OFF — the hysteresis band keeps the gate from flapping."""
+        if self._successor is not None:
+            return self._current().note_pressure(score, conf)
+        from spark_rapids_tpu import config as C
+        if conf is None or not bool(conf.get(C.PRESSURE_ENABLED)):
+            return
+        enter = float(conf.get(C.PRESSURE_BROWNOUT_SCORE))
+        exit_below = float(conf.get(C.PRESSURE_BROWNOUT_EXIT_SCORE))
+        sustain_s = max(
+            int(conf.get(C.PRESSURE_BROWNOUT_SUSTAIN_MS)), 0) / 1000.0
+        now = time.perf_counter()
+        flip = None
+        with self._lock:
+            self._pressure_score = score
+            if score >= enter:
+                if self._pressure_high_since is None:
+                    self._pressure_high_since = now
+                if (not self.brownout_active
+                        and now - self._pressure_high_since >= sustain_s):
+                    self.brownout_active = True
+                    flip = "enter"
+            else:
+                self._pressure_high_since = None
+                if self.brownout_active and score < exit_below:
+                    self.brownout_active = False
+                    flip = "exit"
+        if flip is not None:
+            _record("brownouts" if flip == "enter" else "brownoutExits")
+            from spark_rapids_tpu import monitoring
+            monitoring.instant(
+                f"brownout-{flip}", "recovery",
+                args={"pressureScore": round(score, 4)})
+            from spark_rapids_tpu.monitoring import telemetry
+            if telemetry.enabled():
+                telemetry.set_gauge(
+                    "srt_brownout_active", 1 if flip == "enter" else 0)
+                if flip == "enter":
+                    telemetry.inc("srt_brownouts")
+
     def finish(self, ticket: QueryTicket) -> None:
         """Query teardown (success, failure, or cancel): release the run
         slot, wake the next queued query, disarm the deadline."""
+        if self._successor is not None:
+            return self._current().finish(ticket)
         if ticket.deadline_timer is not None:
             ticket.deadline_timer.cancel()
         service_ms = (time.perf_counter() - ticket.admitted_at) * 1000.0
@@ -522,6 +630,8 @@ class QueryManager:
         first two rungs — neighbors are only touched when that wasn't
         enough. Returns bytes freed; every non-trivial eviction bumps
         ``crossQueryEvictions``."""
+        if self._successor is not None:
+            return self._current().evict_neighbors(requester_id)
         with self._lock:
             victims = [t for qid, t in self._active.items()
                        if qid != requester_id and t.ctx is not None]
@@ -620,13 +730,87 @@ def get_query_manager(conf=None) -> QueryManager:
                  _MANAGER.admission_timeout_ms) != want
                 or (_MANAGER._qos.sig if _MANAGER._qos is not None
                     else None) != _qos_sig(conf)):
+            new_mgr = None
             with _MANAGER._lock:
                 idle = not _MANAGER._active and not _MANAGER._waiters \
                     and (_MANAGER._qos is None
                          or len(_MANAGER._qos.queue) == 0)
-            if idle:
-                _MANAGER = build(want)
+                if idle:
+                    # Idle-check + retirement are ATOMIC under the old
+                    # manager's lock: an admit racing this resize either
+                    # enqueued first (idle is False, no resize) or sees
+                    # the successor and follows the chain — a resize can
+                    # never strand a queued ticket in a dead manager.
+                    new_mgr = build(want)
+                    _MANAGER._successor = new_mgr
+            if new_mgr is not None:
+                _MANAGER = new_mgr
         return _MANAGER
+
+
+def note_pressure(score: float, conf=None) -> None:
+    """Report a dispatch-funnel pressure observation (ops/base.py's
+    collect teardown) to the live manager. No-op before the first query
+    ever built one — pressure without a scheduler has nobody to shed."""
+    with _MANAGER_LOCK:
+        mgr = _MANAGER
+    if mgr is not None:
+        mgr.note_pressure(score, conf)
+
+
+def backoff_ms(hint_ms: Optional[float], attempt: int, seed: int,
+               max_backoff_ms: float) -> float:
+    """Deterministic-jitter client backoff: the server's retry hint
+    stretched by a per-(client, attempt) jitter in [0, 25%), capped.
+    Knuth multiplicative hashing instead of wall-clock randomness keeps
+    a thundering herd spread out *reproducibly* — the convergence test
+    replays the exact same schedule every run."""
+    base = float(hint_ms) if hint_ms and hint_ms > 0 else 250.0
+    jitter = (((seed + 1) * 2654435761 + attempt * 40503) % 1000) / 4000.0
+    return min(base * (1.0 + jitter), float(max_backoff_ms))
+
+
+def collect_with_retry(attempt_fn, conf=None,
+                       max_attempts: Optional[int] = None,
+                       max_backoff_ms: Optional[float] = None,
+                       seed: int = 0, sleep=time.sleep):
+    """Client-side half of the backpressure contract: run one collect
+    attempt; on a :class:`QueryRejectedError` carrying a
+    ``retry_after_ms`` hint, back off for the hinted interval (plus
+    deterministic per-client jitter, capped at
+    ``client.retry.maxBackoffMs``) and resubmit, up to
+    ``client.retry.maxAttempts`` total attempts. Rejections WITHOUT a
+    hint re-raise immediately — the manager only omits the hint when
+    retrying as-is can never help (a deadline the raw cost estimate
+    already exceeds). Every deferred resubmission bumps
+    ``clientRetries``/``srt_client_retries`` so the soak can prove the
+    herd converged instead of hammering."""
+    from spark_rapids_tpu import config as C
+    if max_attempts is None:
+        max_attempts = int(conf.get(C.CLIENT_RETRY_MAX_ATTEMPTS)) \
+            if conf is not None \
+            else int(C.CLIENT_RETRY_MAX_ATTEMPTS.default)
+    if max_backoff_ms is None:
+        max_backoff_ms = float(conf.get(C.CLIENT_RETRY_MAX_BACKOFF_MS)) \
+            if conf is not None \
+            else float(C.CLIENT_RETRY_MAX_BACKOFF_MS.default)
+    max_attempts = max(int(max_attempts), 1)
+    attempt = 0
+    while True:
+        try:
+            return attempt_fn()
+        except QueryRejectedError as e:
+            attempt += 1
+            if e.retry_after_ms is None or attempt >= max_attempts:
+                raise
+            delay_ms = backoff_ms(e.retry_after_ms, attempt, seed,
+                                  max_backoff_ms)
+            _record("clientRetries")
+            _record(f"clientRetries.{e.kind}")
+            from spark_rapids_tpu.monitoring import telemetry
+            if telemetry.enabled():
+                telemetry.inc("srt_client_retries", kind=e.kind)
+            sleep(delay_ms / 1000.0)
 
 
 def query_memory_fraction(conf, manager: QueryManager) -> float:

@@ -178,3 +178,26 @@ def record_recompute(ctx, stage: Stage) -> None:
     query_metrics_entry(ctx, "Recovery").add("stageRecomputes", 1)
     monitoring.instant("stage-recompute", "recovery",
                        args={"stage": stage.name})
+
+
+def materialized_stage_count(ctx, graph: Optional[StageGraph]) -> int:
+    """How many boundary stages still hold a durable, context-cached
+    output right now. Class-aware preemption (plan/planner.py) reads
+    this when a preempted query resumes: every stage counted here is
+    served from its materialization instead of recomputing — the
+    ``resumedStages`` counter that proves a suspension lost no work."""
+    if graph is None or ctx is None:
+        return 0
+    n = 0
+    for st in graph.stages.values():
+        b = st.boundary
+        if b is None:
+            continue                    # the result stage is never durable
+        key_fn = getattr(b, "_cache_key", None)
+        if callable(key_fn):
+            keys = (key_fn(True), key_fn(False))
+        else:                           # mesh exchanges key by exec id
+            keys = (f"meshx:{id(b):x}", f"meshx-host:{id(b):x}")
+        if any(k in ctx.cache for k in keys):
+            n += 1
+    return n

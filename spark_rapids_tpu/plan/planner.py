@@ -577,6 +577,7 @@ class PhysicalPlan:
             int(self.conf.get(C.RECOVERY_MAX_STAGE_RECOMPUTES)), 0)
         stage_recomputes = 0
         same_ctx_retry_used = False
+        preempt_count = 0
         attempt = 0
         import logging
         log = logging.getLogger("spark_rapids_tpu")
@@ -607,6 +608,105 @@ class PhysicalPlan:
                         if not isinstance(e, faults.QueryCancelledError):
                             raise ticket.token.error() from e
                         raise
+                    # Rung 0: class-aware preemption (ISSUE 18) — not a
+                    # failure at all. The classed TPU gate asked this
+                    # query to yield at a partition boundary; spill its
+                    # live device buffers through the existing ladder,
+                    # wait for the preemptor to drain, then re-collect
+                    # on the SAME context: durable stage outputs serve
+                    # from their materializations, so resumption loses
+                    # no completed work and stays byte-identical.
+                    if isinstance(e, faults.QueryPreemptedError) \
+                            and ticket is not None:
+                        preempt_count += 1
+                        budget = max(int(self.conf.get(
+                            C.PREEMPTION_MAX_PER_QUERY)), 0)
+                        if preempt_count > budget:
+                            # Budget spent: this query never yields
+                            # again — starving a victim to death on
+                            # repeated preemptions is worse than one
+                            # slow interactive query.
+                            ticket.token.preempt_enabled = False
+                            ticket.token.clear_preempt()
+                            continue
+                        try:
+                            # Chaos checkpoint: seeded faults can land
+                            # exactly mid-preemption-spill (armed as
+                            # kind@preempt.spill) — they re-enter the
+                            # ladder below like any execution fault.
+                            faults.fault_point("preempt.spill")
+                            freed = 0
+                            if bool(self.conf.get(
+                                    C.PREEMPTION_SPILL_ENABLED)) \
+                                    and ctx._catalog is not None:
+                                # The victim vacates HBM for the
+                                # preemptor via the same device->host
+                                # ladder the OOM path uses (handles stay
+                                # owned: nothing leaks, everything pages
+                                # back on resume).
+                                freed = ctx._catalog.handle_oom()
+                            sched = SC.metrics_entry(ctx)
+                            sched.add("preemptions", 1)
+                            SC._record("preemptions")
+                            monitoring.instant(
+                                "query-preempted", "recovery",
+                                qid=trace_qid,
+                                args={"preemptor": e.preemptor or "-",
+                                      "spilledBytes": freed,
+                                      "count": preempt_count})
+                            monitoring.telemetry.inc(
+                                "srt_preemptions",
+                                **{"class": str(ticket.qos_class
+                                                or "-")})
+                            log.warning(
+                                "query %d preempted by a %s query "
+                                "(%d/%d, spilled %d bytes); resuming "
+                                "after the preemptor drains", trace_qid,
+                                e.preemptor or "higher-priority",
+                                preempt_count, budget, freed)
+                            from spark_rapids_tpu.memory.stores import \
+                                get_tpu_semaphore
+                            sem = get_tpu_semaphore(max(
+                                int(self.conf.get(
+                                    C.CONCURRENT_TPU_TASKS)), 1))
+                            t0_pre = _time.perf_counter()
+                            # Blocks in class order until a permit
+                            # would be ours again — i.e. the preemptor
+                            # (and anything ranked ahead) drained.
+                            # Cancellation/deadline aborts the wait via
+                            # the token.
+                            sem.wait_resume(ticket.token)
+                            ticket.token.clear_preempt()
+                            preempted_ms = (_time.perf_counter()
+                                            - t0_pre) * 1e3
+                            resumed = S.materialized_stage_count(
+                                ctx, graph)
+                            sched.add("preemptedMs", preempted_ms)
+                            sched.add("resumedStages", resumed)
+                            SC._record("preemptedMs", preempted_ms)
+                            SC._record("resumedStages", resumed)
+                            monitoring.instant(
+                                "query-resumed", "recovery",
+                                qid=trace_qid,
+                                args={"preemptedMs":
+                                      round(preempted_ms, 2),
+                                      "resumedStages": resumed})
+                            # Mid-resume chaos checkpoint
+                            # (kind@preempt.resume).
+                            faults.fault_point("preempt.resume")
+                            continue
+                        except faults.QueryCancelledError:
+                            raise
+                        except Exception as e2:
+                            # A fault landed mid-spill or mid-resume:
+                            # clear the preempt flag (the gate wait, if
+                            # reached, already honored it) and re-enter
+                            # the ladder with the NEW error — stage
+                            # recompute / transient retry / fresh
+                            # context apply exactly as for any
+                            # execution-time fault.
+                            ticket.token.clear_preempt()
+                            e = e2
                     # Rung 1: lineage-scoped stage recompute.
                     st = S.stage_for_error(graph, e)
                     if st is not None and stage_recomputes < stage_budget:

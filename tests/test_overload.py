@@ -1,0 +1,473 @@
+"""Overload survival plane (ISSUE 18): memory-pressure shedding,
+brownout admission, client backpressure convergence, and the mixed-
+tenant step-load soak.
+
+The contracts under test:
+
+- ``stores.pressure_score`` blends the catalog watermarks with the
+  device fraction dominant (it is what OOMs), clamped per tier.
+- The brownout state machine (QueryManager.note_pressure) flips ON only
+  after the enter score is SUSTAINED for brownout.sustainMs, stays on
+  through the hysteresis band, and flips OFF below the exit score; the
+  default-off gate never flips at all.
+- During brownout, BACKGROUND admissions shed with kind="brownout" and
+  a retry hint while interactive/batch still admit.
+- ``collect_with_retry`` is the obedient client: it honors
+  ``retry_after_ms`` with capped deterministic-jitter backoff, re-raises
+  hintless rejections immediately, gives up after maxAttempts — and a
+  herd of such clients converges end to end.
+- Cluster placement demotes a pressured worker (CBEAT telemetry
+  piggyback -> _pick_locked) below steal-delay preference so it sheds
+  NEW stages to its peers.
+- Step-load soak: a 4x background step spike, with preemption + retry
+  enabled, keeps interactive latency bounded, keeps background making
+  forward progress, and returns only byte-correct rows.
+"""
+
+import base64
+import json
+import threading
+import time
+import types
+
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.memory import oom, stores
+from spark_rapids_tpu.parallel import cluster as CL
+from spark_rapids_tpu.parallel import qos as Q
+from spark_rapids_tpu.parallel import scheduler as SC
+from spark_rapids_tpu.parallel.cluster import coordinator as CO
+from spark_rapids_tpu.parallel.scheduler import (
+    QueryManager, QueryRejectedError)
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    Q.reset_counters()
+    oom.reset_degradation()
+    # The process-global device semaphore is sized by the FIRST collect
+    # in the process; drop it so the soak's concurrentTpuTasks=1 sizes
+    # a fresh gate (a wider inherited gate removes the contention the
+    # step-load assertions depend on).
+    with stores._GLOBAL_SEM_LOCK:
+        stores._GLOBAL_SEM = None
+    yield
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    Q.reset_counters()
+    oom.reset_degradation()
+    stores._PREEMPT_ENABLED = False
+    with stores._GLOBAL_SEM_LOCK:
+        stores._GLOBAL_SEM = None
+    # Tests here rebuild the process-wide manager in QoS mode and at
+    # odd sizes; drop it so later modules start from the default.
+    with SC._MANAGER_LOCK:
+        SC._MANAGER = None
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_overload"))
+    tpch.generate(d, scale=0.02, files_per_table=10, seed=11)
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Pressure score
+# ---------------------------------------------------------------------------
+
+def _cat(dev, host=0, disk=0, dev_budget=100, host_budget=100):
+    return types.SimpleNamespace(
+        device_bytes=dev, device_budget=dev_budget,
+        host_bytes=host, host_budget=host_budget, disk_bytes=disk)
+
+
+def test_pressure_score_blend_and_clamp():
+    assert stores.pressure_score(None) == 0.0
+    assert stores.pressure_score(_cat(0)) == 0.0
+    assert stores.pressure_score(_cat(50)) == 0.5
+    # Device dominates; host and disk add the smaller terms.
+    assert stores.pressure_score(_cat(50, 40, 20)) == pytest.approx(
+        0.5 + 0.25 * 0.4 + 0.1 * 0.2)
+    # Each tier fraction clamps at 1 — a ladder deep into disk spill
+    # reads hotter than merely-full but stays bounded.
+    assert stores.pressure_score(_cat(500, 500, 500)) == pytest.approx(
+        1.35)
+
+
+# ---------------------------------------------------------------------------
+# Brownout state machine
+# ---------------------------------------------------------------------------
+
+def _pressure_conf(sustain_ms=40, enter=0.9, exit_=0.7, enabled=True):
+    s = TpuSession()
+    if enabled:
+        s.set("spark.rapids.sql.scheduler.pressure.enabled", True)
+    s.set("spark.rapids.sql.scheduler.pressure.brownout.sustainMs",
+          sustain_ms)
+    s.set("spark.rapids.sql.scheduler.pressure.brownout.enterScore",
+          enter)
+    s.set("spark.rapids.sql.scheduler.pressure.brownout.exitScore",
+          exit_)
+    return s.conf
+
+
+def test_brownout_enters_after_sustain_exits_below_floor():
+    mgr = QueryManager(max_concurrent=2)
+    conf = _pressure_conf(sustain_ms=40)
+    mgr.note_pressure(0.95, conf)
+    assert not mgr.brownout_active          # spike, not yet sustained
+    time.sleep(0.06)
+    mgr.note_pressure(0.95, conf)
+    assert mgr.brownout_active
+    assert SC.counters().get("brownouts", 0) == 1
+    # Hysteresis band: below enter but above exit keeps it on.
+    mgr.note_pressure(0.8, conf)
+    assert mgr.brownout_active
+    mgr.note_pressure(0.5, conf)
+    assert not mgr.brownout_active
+    assert SC.counters().get("brownoutExits", 0) == 1
+
+
+def test_brownout_requires_sustained_pressure():
+    """A transient spike above the enter score never flips the gate —
+    the sustain window is what separates a hot partition from real
+    overload."""
+    mgr = QueryManager(max_concurrent=2)
+    conf = _pressure_conf(sustain_ms=60000)
+    mgr.note_pressure(0.99, conf)
+    mgr.note_pressure(0.99, conf)
+    assert not mgr.brownout_active
+    # Dropping below enter resets the sustain clock entirely.
+    mgr.note_pressure(0.1, conf)
+    assert mgr._pressure_high_since is None
+
+
+def test_brownout_gate_off_by_default():
+    mgr = QueryManager(max_concurrent=2)
+    conf = _pressure_conf(sustain_ms=0, enabled=False)
+    mgr.note_pressure(0.99, conf)
+    mgr.note_pressure(0.99, conf)
+    assert not mgr.brownout_active
+    mgr.note_pressure(0.99, None)           # no conf at all: no-op
+    assert not mgr.brownout_active
+    assert SC.counters().get("brownouts", 0) == 0
+
+
+def test_brownout_sheds_background_admits_interactive():
+    """During brownout, background admissions reject with
+    kind="brownout" and a retry hint; interactive and batch admit."""
+    mgr = QueryManager(max_concurrent=2, queue_depth=4,
+                       admission_timeout_ms=2000,
+                       qos=Q.QosPolicy("8,3,1", 8))
+    mgr.brownout_active = True
+    mgr._pressure_score = 0.93
+    with pytest.raises(QueryRejectedError, match="brownout") as ei:
+        mgr.admit(priority="background")
+    assert ei.value.kind == "brownout"
+    assert ei.value.retry_after_ms is not None
+    assert ei.value.retry_after_ms > 0
+    t_i = mgr.admit(priority="interactive")
+    t_b = mgr.admit(priority="batch")
+    mgr.finish(t_i)
+    mgr.finish(t_b)
+    assert Q.counters().get("rejected.brownout", 0) >= 1
+    # Gate lifted: background admits again.
+    mgr.brownout_active = False
+    t_bg = mgr.admit(priority="background")
+    mgr.finish(t_bg)
+
+
+# ---------------------------------------------------------------------------
+# Client backpressure: backoff_ms + collect_with_retry
+# ---------------------------------------------------------------------------
+
+def test_backoff_ms_deterministic_jittered_capped():
+    # Exact replay: same (hint, attempt, seed) -> same delay.
+    assert SC.backoff_ms(100.0, 1, 3, 10000.0) == \
+        SC.backoff_ms(100.0, 1, 3, 10000.0)
+    # Jitter stretches the hint by [0, 25%), never shrinks it.
+    for seed in range(16):
+        d = SC.backoff_ms(100.0, 1, seed, 10000.0)
+        assert 100.0 <= d < 125.0
+    # Different clients spread out (not all identical).
+    delays = {SC.backoff_ms(100.0, 1, seed, 10000.0)
+              for seed in range(16)}
+    assert len(delays) > 1
+    # The cap wins over any hint.
+    assert SC.backoff_ms(100000.0, 1, 0, 500.0) == 500.0
+    # A missing/zero hint falls back to the 250ms prior.
+    assert 250.0 <= SC.backoff_ms(None, 1, 0, 10000.0) < 312.5
+    assert 250.0 <= SC.backoff_ms(0.0, 1, 0, 10000.0) < 312.5
+
+
+def _rejector(fail_times, hint=20.0, kind="queue-full"):
+    """attempt_fn failing ``fail_times`` times then returning rows."""
+    state = {"n": 0}
+
+    def fn():
+        state["n"] += 1
+        if state["n"] <= fail_times:
+            raise QueryRejectedError("shed", kind=kind, queue_depth=1,
+                                     retry_after_ms=hint)
+        return [("ok",)]
+
+    return fn, state
+
+
+def test_collect_with_retry_honors_hint():
+    slept = []
+    fn, state = _rejector(2, hint=20.0)
+    rows = SC.collect_with_retry(fn, max_attempts=5, max_backoff_ms=1e4,
+                                 seed=7, sleep=slept.append)
+    assert rows == [("ok",)]
+    assert state["n"] == 3
+    assert slept == [SC.backoff_ms(20.0, 1, 7, 1e4) / 1000.0,
+                     SC.backoff_ms(20.0, 2, 7, 1e4) / 1000.0]
+    assert SC.counters().get("clientRetries", 0) == 2
+    assert SC.counters().get("clientRetries.queue-full", 0) == 2
+
+
+def test_collect_with_retry_reraises_hintless():
+    """No hint means retrying as-is can never help (deadline-unmeetable
+    by raw cost): re-raise immediately, zero sleeps."""
+    slept = []
+    fn, state = _rejector(5, hint=None, kind="deadline-unmeetable")
+    with pytest.raises(QueryRejectedError):
+        SC.collect_with_retry(fn, max_attempts=5, max_backoff_ms=1e4,
+                              sleep=slept.append)
+    assert state["n"] == 1
+    assert slept == []
+    assert SC.counters().get("clientRetries", 0) == 0
+
+
+def test_collect_with_retry_exhausts_attempts():
+    slept = []
+    fn, state = _rejector(100, hint=10.0)
+    with pytest.raises(QueryRejectedError):
+        SC.collect_with_retry(fn, max_attempts=3, max_backoff_ms=1e4,
+                              sleep=slept.append)
+    assert state["n"] == 3
+    assert len(slept) == 2
+
+
+def test_collect_with_retry_defaults_from_conf():
+    s = TpuSession()
+    s.set("spark.rapids.sql.client.retry.maxAttempts", 2)
+    slept = []
+    fn, state = _rejector(100, hint=10.0)
+    with pytest.raises(QueryRejectedError):
+        SC.collect_with_retry(fn, conf=s.conf, sleep=slept.append)
+    assert state["n"] == 2
+
+
+def test_collect_with_retry_converges_e2e(data_dir):
+    """A rejected-then-retried collect lands once the slot frees: the
+    client converges onto the scheduler's service rate instead of
+    erroring out."""
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 1)
+    s.set("spark.rapids.sql.scheduler.queueDepth", 0)
+    s.set("spark.rapids.sql.scheduler.admissionTimeoutMs", 2000)
+    df = tpch.QUERIES["q6"](s, data_dir)
+    want = df.collect()
+    mgr = SC.get_query_manager(s.conf)
+    hog = mgr.admit()
+    releaser = threading.Timer(0.25, mgr.finish, args=(hog,))
+    releaser.daemon = True
+    releaser.start()
+    try:
+        got = df.collect_with_retry(max_attempts=10, seed=3)
+    finally:
+        releaser.join(10)
+    assert got == want
+    assert SC.counters().get("clientRetries", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# Cluster placement demotion (CBEAT pressure piggyback -> _pick_locked)
+# ---------------------------------------------------------------------------
+
+def test_cbeat_telemetry_carries_pressure_score():
+    conf = TpuSession().conf
+    co = CL.get_coordinator(conf)
+    try:
+        co.dispatch(["CREG", "wA"])
+        blob = base64.b64encode(json.dumps(
+            {"series": {"srt_pressure_score|": 0.91},
+             "kinds": {}}).encode()).decode()
+        co.dispatch(["CBEAT", "wA", blob])
+        assert co.workers["wA"].pressure == pytest.approx(0.91)
+    finally:
+        CL.shutdown_coordinator()
+
+
+def test_pick_demotes_pressured_worker():
+    """A worker at/past shedScore loses both the steal-delay
+    reservation and the pick to an unpressured peer — even for a stage
+    it rendezvous-owns — so it sheds NEW stages instead of spilling
+    under them."""
+    s = TpuSession()
+    s.set("spark.rapids.sql.scheduler.pressure.enabled", True)
+    s.set("spark.rapids.sql.scheduler.pressure.shedScore", 0.75)
+    conf = s.conf
+    co = CL.get_coordinator(conf)
+    try:
+        sid = next(n for n in range(1, 50)
+                   if CO._hrw_owner(["wA", "wB"], n) == "wA")
+        q = CO.QueryRun(co, 96, conf, {sid: CO._StageTask(sid, set())},
+                        {})
+        with co._lock:
+            co.queries[96] = q
+            co._touch_locked("wA")
+            co._touch_locked("wB")
+            co.workers["wA"].pressure = 0.9
+            assert q._pick_locked("wA") is None     # shed to the peer
+            _, picked = q._pick_locked("wB")
+            assert picked.sid == sid and picked.worker == "wB"
+            co.queries.pop(96)
+    finally:
+        CL.shutdown_coordinator()
+
+
+def test_pick_all_pressured_collapses_to_old_order():
+    """All-pressured (or gate off) collapses the demotion tier to a
+    constant: placement is exactly the old (locality, affinity) order —
+    work conservation never deadlocks on pressure."""
+    s = TpuSession()
+    s.set("spark.rapids.sql.scheduler.pressure.enabled", True)
+    s.set("spark.rapids.sql.scheduler.pressure.shedScore", 0.75)
+    conf = s.conf
+    co = CL.get_coordinator(conf)
+    try:
+        sid = next(n for n in range(1, 50)
+                   if CO._hrw_owner(["wA", "wB"], n) == "wA")
+        q = CO.QueryRun(co, 95, conf, {sid: CO._StageTask(sid, set())},
+                        {})
+        with co._lock:
+            co.queries[95] = q
+            co._touch_locked("wA")
+            co._touch_locked("wB")
+            co.workers["wA"].pressure = 0.9
+            co.workers["wB"].pressure = 0.95
+            _, picked = q._pick_locked("wA")        # owner keeps it
+            assert picked.sid == sid and picked.worker == "wA"
+            co.queries.pop(95)
+    finally:
+        CL.shutdown_coordinator()
+
+
+# ---------------------------------------------------------------------------
+# Step-load soak
+# ---------------------------------------------------------------------------
+
+def _soak_session():
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    # Device-rooted plans only — host-rooted roots never touch the
+    # device gate, so nothing would ever preempt.
+    s.set("spark.rapids.sql.cost.enabled", False)
+    # Admission admits the whole mixed fleet; the CLASS-RANKED DEVICE
+    # GATE (concurrentTpuTasks=1 below) is what orders the spike — an
+    # interactive arrival preempts the background holder there.
+    s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 6)
+    s.set("spark.rapids.sql.scheduler.queueDepth", 4)
+    s.set("spark.rapids.sql.scheduler.qos.enabled", True)
+    s.set("spark.rapids.sql.scheduler.preemption.enabled", True)
+    s.set("spark.rapids.sql.concurrentTpuTasks", 1)
+    return s
+
+
+@pytest.mark.slow
+def test_step_load_soak(data_dir):
+    """Mixed-tenant step-load: a 4x background step spike lands on a
+    steady interactive client. Interactive latency stays bounded
+    (preemption keeps the device from being held hostage), background
+    keeps making forward progress through shed/retry (no starvation,
+    no errors), and every row returned on both sides is byte-correct.
+
+    Slow-marked like the qos soak: the ``step-load-soak`` tier-1 matrix
+    entry runs this module without the marker filter every CI run."""
+    want = tpch.QUERIES["q1"](_soak_session(), data_dir).collect()
+
+    # Unloaded interactive latency profile (after the warmup above).
+    unloaded = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        got = tpch.QUERIES["q1"](_soak_session(), data_dir) \
+            .collect(priority="interactive")
+        unloaded.append(time.perf_counter() - t0)
+        assert got == want
+    SC.reset_counters()
+
+    # THE STEP: 4 sustained background clients arrive at once.
+    stop = threading.Event()
+    bg_done = []
+    bg_bad = []
+    bg_errors = []
+
+    def bg_client(k):
+        df = tpch.QUERIES["q1"](_soak_session(), data_dir)
+        while not stop.is_set():
+            try:
+                rows = df.collect_with_retry(
+                    priority="background", tenant=f"t{k % 2}",
+                    max_attempts=50, max_backoff_ms=500.0, seed=k)
+            except QueryRejectedError:
+                continue        # shed through max attempts: back off
+            except Exception as e:              # pragma: no cover
+                bg_errors.append(e)
+                return
+            if rows != want:
+                bg_bad.append(k)
+                return
+            bg_done.append(k)
+
+    threads = [threading.Thread(target=bg_client, args=(k,), daemon=True)
+               for k in range(4)]
+    for t in threads:
+        t.start()
+
+    # Interactive client rides through the spike.
+    loaded = []
+    try:
+        for i in range(4):
+            t0 = time.perf_counter()
+            got = tpch.QUERIES["q1"](_soak_session(), data_dir) \
+                .collect_with_retry(priority="interactive",
+                                    tenant="fg", seed=100 + i)
+            loaded.append(time.perf_counter() - t0)
+            assert got == want, "interactive rows diverged under load"
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(120)
+
+    assert not bg_errors, bg_errors
+    assert not bg_bad, "background rows diverged under load"
+    # Graceful degradation = forward progress, not a fixed rate.
+    assert len(bg_done) >= 1, "background starved outright"
+
+    ctrs = SC.counters()
+    assert ctrs.get("preemptions", 0) >= 1, \
+        "the spike never exercised class preemption"
+
+    # Interactive latency bound: p99 (max of the window) within 2x the
+    # unloaded profile, plus a small absolute floor for scheduler
+    # jitter at CI data scale (sub-second queries).
+    unloaded_p99 = max(unloaded)
+    loaded_p99 = max(loaded)
+    assert loaded_p99 <= 2.0 * unloaded_p99 + 0.75, \
+        (f"interactive p99 {loaded_p99:.2f}s vs unloaded "
+         f"{unloaded_p99:.2f}s x2 under the step spike "
+         f"(preemptions={ctrs.get('preemptions')}, "
+         f"clientRetries={ctrs.get('clientRetries', 0)})")

@@ -1,0 +1,345 @@
+"""Class-aware device preemption (ISSUE 18; memory/stores.py classed
+gate + plan/planner.py rung 0 + faults.py preempt flag).
+
+The contracts under test:
+
+- The classed gate only ever preempts a STRICTLY lower class: an
+  interactive head waiter asks a running background/batch holder to
+  yield; equal classes queue without preempting; a holder whose
+  per-query preemption budget is spent (``preempt_enabled`` off) is
+  never picked as a victim.
+- A preempted query yields at a partition boundary, spills its live
+  device buffers through the existing ladder, resumes after the
+  preemptor drains, and returns rows BYTE-IDENTICAL to a solo run —
+  with ``preemptions``/``preemptedMs``/``resumedStages`` recorded and
+  an EMPTY leak report.
+- Seeded ``oom``/``transient``/``lostoutput`` chaos landing
+  mid-preemption-spill / mid-resume (the ``preempt.spill`` /
+  ``preempt.resume`` fault sites) re-enters the recovery ladder:
+  results stay bit-identical with exactly the expected recovery
+  counters.
+- With ``scheduler.preemption.enabled=false`` (the default) the gate
+  is byte-for-byte the flat class-blind semaphore.
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.faults import QueryPreemptedError, QueryToken
+from spark_rapids_tpu.memory import oom, stores
+from spark_rapids_tpu.memory.stores import TpuSemaphore
+from spark_rapids_tpu.parallel import scheduler as SC
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    oom.reset_degradation()
+    # The process-global device semaphore is sized by the FIRST collect
+    # in the process (reference semantics); drop it so this module's
+    # concurrentTpuTasks=1 actually takes effect — with a wider gate a
+    # second query walks straight in and no preemption window exists.
+    with stores._GLOBAL_SEM_LOCK:
+        stores._GLOBAL_SEM = None
+    yield
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    oom.reset_degradation()
+    stores._PREEMPT_ENABLED = False
+    with stores._GLOBAL_SEM_LOCK:
+        stores._GLOBAL_SEM = None
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_preempt"))
+    # Enough partitions that a background query reliably has work left
+    # when the interactive one arrives at the gate.
+    tpch.generate(d, scale=0.02, files_per_table=10, seed=11)
+    return d
+
+
+def _session(preempt=True, tag=None, chaos=""):
+    s = TpuSession()
+    s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    # Cost-based placement would put the tiny final sort on the host,
+    # skipping the device collect funnel (and so the gate) entirely.
+    s.set("spark.rapids.sql.cost.enabled", False)
+    s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", 4)
+    s.set("spark.rapids.sql.scheduler.qos.enabled", True)
+    s.set("spark.rapids.sql.scheduler.preemption.enabled", preempt)
+    s.set("spark.rapids.sql.concurrentTpuTasks", 1)
+    s.set("spark.rapids.sql.retry.backoffMs", 1)
+    if chaos:
+        # Only the chaos session carries the faults key: an explicit
+        # empty spec on the OTHER session would disarm the schedule
+        # (faults.maybe_configure adopts per collect, last writer wins).
+        s.set("spark.rapids.sql.test.faults", chaos)
+        s.set("spark.rapids.sql.test.faults.seed", 11)
+        s.set("spark.rapids.sql.format.scanCache.maxBytes", 0)
+    if tag is not None:
+        s.set("spark.rapids.sql.test.faults.queryTag", tag)
+    return s
+
+
+@pytest.fixture(scope="module")
+def baseline(data_dir):
+    return tpch.QUERIES["q1"](_session(False), data_dir).collect()
+
+
+# ---------------------------------------------------------------------------
+# Gate unit tests (no data, fabricated tokens)
+# ---------------------------------------------------------------------------
+
+def _classed_gate(monkeypatch):
+    monkeypatch.setattr(stores, "_PREEMPT_ENABLED", True)
+    return TpuSemaphore(1)
+
+
+def _tok(qid, cls):
+    return QueryToken(qid, qos_class=cls)
+
+
+def test_gate_preempts_lower_class(monkeypatch):
+    """An interactive head waiter asks the running background holder to
+    yield, naming the preemptor class; the permit hands over once the
+    victim releases."""
+    sem = _classed_gate(monkeypatch)
+    bg = _tok(1, "background")
+    sem._acquire_classed(bg)
+    assert sem.holders == [(1, 2)]
+
+    it = _tok(2, "interactive")
+    got = threading.Event()
+
+    def want():
+        sem._acquire_classed(it)
+        got.set()
+
+    t = threading.Thread(target=want, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10
+    while not bg.preempt_requested() and time.monotonic() < deadline:
+        time.sleep(0.002)
+    assert bg.preempt_requested(), "holder never asked to yield"
+    assert bg.preemptor_class == "interactive"
+    assert sem.preempt_requests == 1
+    assert not got.is_set(), "permit handed over before the release"
+    sem.release_classed(bg)             # the victim unwinds
+    assert got.wait(10)
+    sem.release_classed(it)
+    t.join(10)
+
+
+def test_gate_same_class_queues_without_preempting(monkeypatch):
+    """Equal classes never preempt each other: the second batch query
+    just waits its turn."""
+    sem = _classed_gate(monkeypatch)
+    a = _tok(1, "batch")
+    sem._acquire_classed(a)
+    b = _tok(2, "batch")
+    got = threading.Event()
+
+    def want():
+        sem._acquire_classed(b)
+        got.set()
+
+    t = threading.Thread(target=want, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not a.preempt_requested()
+    assert sem.preempt_requests == 0
+    sem.release_classed(a)
+    assert got.wait(10)
+    sem.release_classed(b)
+    t.join(10)
+
+
+def test_gate_skips_budget_spent_victims(monkeypatch):
+    """A holder whose per-query preemption budget is spent
+    (preempt_enabled off) is never picked as a victim."""
+    sem = _classed_gate(monkeypatch)
+    bg = _tok(1, "background")
+    bg.preempt_enabled = False
+    sem._acquire_classed(bg)
+    it = _tok(2, "interactive")
+    got = threading.Event()
+
+    def want():
+        sem._acquire_classed(it)
+        got.set()
+
+    t = threading.Thread(target=want, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert not bg.preempt.is_set()
+    assert sem.preempt_requests == 0
+    sem.release_classed(bg)
+    assert got.wait(10)
+    sem.release_classed(it)
+    t.join(10)
+
+
+def test_wait_resume_noop_when_disabled():
+    sem = TpuSemaphore(1)
+    t0 = time.monotonic()
+    sem.wait_resume(_tok(1, "background"))
+    assert time.monotonic() - t0 < 0.5
+
+
+def test_check_preempted_honors_flag_and_budget():
+    """faults.check_preempted raises only while the preempt flag is set
+    AND the token still honors preemption."""
+    tok = _tok(7, "background")
+    faults.set_query_token(tok)
+    try:
+        faults.check_preempted()        # no flag: no-op
+        tok.request_preempt("interactive")
+        with pytest.raises(QueryPreemptedError) as ei:
+            faults.check_preempted()
+        assert ei.value.preemptor == "interactive"
+        assert ei.value.query_id == 7
+        tok.clear_preempt()
+        faults.check_preempted()        # cleared: no-op again
+        tok.request_preempt("interactive")
+        tok.preempt_enabled = False     # budget spent
+        faults.check_preempted()
+    finally:
+        faults.set_query_token(None)
+
+
+def test_flat_semaphore_unchanged_when_disabled(data_dir, baseline):
+    """The default-off gate is byte-for-byte the old flat semaphore:
+    background + interactive queries both run, nothing preempts."""
+    bg = tpch.QUERIES["q1"](_session(False), data_dir) \
+        .submit(priority="background")
+    fg = tpch.QUERIES["q1"](_session(False), data_dir) \
+        .collect(priority="interactive")
+    assert fg == baseline
+    assert bg.result(timeout=300) == baseline
+    assert SC.counters().get("preemptions", 0) == 0
+    assert stores.get_tpu_semaphore(1).holders == []
+
+
+# ---------------------------------------------------------------------------
+# End-to-end preemption (+ chaos riding along)
+# ---------------------------------------------------------------------------
+
+def _run_preemption_scenario(data_dir, bg_chaos="", bg_tag=None,
+                             attempts=3):
+    """Launch a background q1, wait until it holds the device gate, then
+    collect an interactive q1 — retrying the whole scenario when timing
+    denied a preemption window (the background query drained first).
+    Returns (bg_rows, fg_rows, victim_physical)."""
+    sem = stores.get_tpu_semaphore(1)
+    for attempt in range(attempts):
+        SC.reset_counters()
+        df_bg = tpch.QUERIES["q1"](
+            _session(tag=bg_tag, chaos=bg_chaos), data_dir)
+        handle = df_bg.submit(priority="background")
+        deadline = time.monotonic() + 60
+        while not sem.holders and time.monotonic() < deadline:
+            time.sleep(0.001)
+        fg = tpch.QUERIES["q1"](_session(), data_dir) \
+            .collect(priority="interactive")
+        bg = handle.result(timeout=300)
+        if SC.counters().get("preemptions", 0) >= 1:
+            return bg, fg, df_bg._physical()
+    pytest.fail(f"no preemption in {attempts} scenario attempts")
+
+
+def test_preemption_end_to_end_bit_identical(data_dir, baseline):
+    """The victim yields, spills, resumes after the preemptor drains;
+    BOTH queries return rows identical to solo runs, the counters
+    record the suspension, and the victim's leak report is empty."""
+    bg, fg, phys = _run_preemption_scenario(data_dir)
+    assert fg == baseline, "preemptor diverged"
+    assert bg == baseline, "victim diverged after preemption"
+    ctrs = SC.counters()
+    assert ctrs.get("preemptions", 0) >= 1
+    assert ctrs.get("preemptedMs", 0) > 0
+    assert ctrs.get("resumedStages", 0) >= 1, \
+        "resume recomputed every stage — durable outputs were dropped"
+    assert stores.get_tpu_semaphore(1).preempt_requests >= 1
+    ctx = phys.last_ctx
+    assert ctx is not None and ctx.last_leak_report == [], \
+        f"preempted query leaked buffers: {ctx.last_leak_report}"
+
+
+@pytest.mark.parametrize("kind,site,counter", [
+    # Mid-preemption-spill: the fault fires INSIDE the preemption rung,
+    # before the spill moves a byte — it re-enters the ladder as a
+    # same-context transient retry.
+    ("transient", "preempt.spill", "retriesAttempted"),
+    # Mid-resume: the fault fires right after the gate re-granted the
+    # victim's class — same ladder, same counters.
+    ("transient", "preempt.resume", "retriesAttempted"),
+    # A durable output lost mid-resume carries UNAVAILABLE (and no
+    # owner at this site), so the whole-query rung recovers it.
+    ("lostoutput", "preempt.resume", "retriesAttempted"),
+])
+def test_preemption_chaos_mid_rung(data_dir, baseline, kind, site,
+                                   counter):
+    """Seeded faults landing exactly mid-preemption-spill / mid-resume
+    stay bit-identical with the expected recovery counters and an empty
+    leak report."""
+    chaos = f"{kind}@{site}/query=1:1"
+    bg, fg, phys = _run_preemption_scenario(
+        data_dir, bg_chaos=chaos, bg_tag=1)
+    assert fg == baseline
+    assert bg == baseline, f"victim diverged under {chaos}"
+    assert faults.counters().get(counter, 0) >= 1, \
+        f"{chaos} never re-entered the ladder"
+    assert faults.counters().get(
+        f"faultsInjected.{kind}@{site}", 0) >= 1, \
+        f"{chaos} never fired"
+    assert SC.counters().get("preemptions", 0) >= 1
+    ctx = phys.last_ctx
+    assert ctx is not None and ctx.last_leak_report == []
+
+
+def test_preemption_chaos_oom_in_victim(data_dir, baseline):
+    """An injected device OOM in the victim's own dispatch funnel (the
+    partitions it runs around the suspension) engages the spill ladder
+    as usual: bit-identical rows, the retry recorded, no leaks. (One
+    fire only: a second would exhaust the shrink rung into a host
+    fallback, which legitimately reorders float sums.)"""
+    bg, fg, phys = _run_preemption_scenario(
+        data_dir, bg_chaos="oom@upload/query=1:1", bg_tag=1)
+    assert fg == baseline
+    assert bg == baseline, "victim diverged under injected OOM"
+    assert faults.counters().get("retriesAttempted", 0) >= 1
+    assert SC.counters().get("preemptions", 0) >= 1
+    ctx = phys.last_ctx
+    assert ctx is not None and ctx.last_leak_report == []
+
+
+def test_preemption_budget_caps_yields(data_dir, baseline,
+                                       monkeypatch):
+    """With maxPerQuery=0 every preemption request is immediately
+    declined (budget spent on the first ask): the victim finishes
+    without ever yielding again, still bit-identical."""
+    sem = stores.get_tpu_semaphore(1)
+    s = _session()
+    s.set("spark.rapids.sql.scheduler.preemption.maxPerQuery", 0)
+    df_bg = tpch.QUERIES["q1"](s, data_dir)
+    handle = df_bg.submit(priority="background")
+    deadline = time.monotonic() + 60
+    while not sem.holders and time.monotonic() < deadline:
+        time.sleep(0.001)
+    fg = tpch.QUERIES["q1"](_session(), data_dir) \
+        .collect(priority="interactive")
+    bg = handle.result(timeout=300)
+    assert fg == baseline
+    assert bg == baseline
+    # The gate may have asked, but the rung never paid a suspension.
+    assert SC.counters().get("preemptions", 0) == 0

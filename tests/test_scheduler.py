@@ -388,3 +388,168 @@ def test_cross_query_eviction_rung():
     mgr.finish(ta)
     mgr.finish(tb)
     FakeCtx._catalog.close()
+
+
+# ---------------------------------------------------------------------------
+# Retry-hint contract: every load rejection carries retry_after_ms
+# (ISSUE 18 satellite — the deadline-unmeetable kind used to ship None
+# even when only the load-scaled slack made it unmeetable)
+# ---------------------------------------------------------------------------
+
+def _qos_mgr(max_concurrent=1, queue_depth=0, timeout_ms=80):
+    from spark_rapids_tpu.parallel import qos as Q
+    return QueryManager(max_concurrent=max_concurrent,
+                        queue_depth=queue_depth,
+                        admission_timeout_ms=timeout_ms,
+                        qos=Q.QosPolicy("8,3,1", 8))
+
+
+def _qos_conf(**over):
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    s = TpuSession()
+    s.set("spark.rapids.sql.scheduler.qos.enabled", True)
+    for k, v in over.items():
+        s.set(k, v)
+    return s.conf
+
+
+def test_hint_on_queue_full_scales_with_depth():
+    mgr = _qos_mgr(queue_depth=0)
+    hog = mgr.admit()
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit()
+    assert ei.value.kind == "queue-full"
+    assert ei.value.retry_after_ms is not None
+    assert ei.value.retry_after_ms >= 50.0
+    mgr.finish(hog)
+
+
+def test_hint_on_admission_timeout():
+    mgr = _qos_mgr(queue_depth=4, timeout_ms=60)
+    hog = mgr.admit()
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit()
+    assert ei.value.kind == "admission-timeout"
+    assert ei.value.retry_after_ms is not None and \
+        ei.value.retry_after_ms > 0
+    mgr.finish(hog)
+
+
+def test_hint_on_tenant_quota():
+    mgr = _qos_mgr(max_concurrent=4, queue_depth=4)
+    conf = _qos_conf(**{
+        "spark.rapids.sql.scheduler.qos.tenantMaxInFlight": 1})
+    first = mgr.admit(conf, tenant="acme")
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit(conf, tenant="acme")
+    assert ei.value.kind == "tenant-quota"
+    assert ei.value.retry_after_ms is not None and \
+        ei.value.retry_after_ms > 0
+    mgr.finish(first)
+
+
+def test_hint_on_deadline_unmeetable_load_scaled_vs_hopeless():
+    """A deadline only the load-scaled slack breaks can succeed on
+    resubmission (a drained queue shrinks the slack): hint carried. A
+    deadline the RAW cost estimate already exceeds can never succeed
+    as-is: hint None — collect_with_retry re-raises immediately."""
+    mgr = _qos_mgr(max_concurrent=4, queue_depth=4)
+    conf = _qos_conf(**{
+        "spark.rapids.sql.scheduler.qos.deadlineAdmission.enabled": True,
+        "spark.rapids.sql.scheduler.qos.deadlineSlack": 2.0})
+    # cost 80 <= deadline 100, but 80 * 2.0 slack = 160 > 100.
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit(conf, cost_ms=80.0, deadline_ms=100.0)
+    assert ei.value.kind == "deadline-unmeetable"
+    assert ei.value.retry_after_ms is not None and \
+        ei.value.retry_after_ms > 0
+    # cost 300 > deadline 100 raw: hopeless, no hint.
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit(conf, cost_ms=300.0, deadline_ms=100.0)
+    assert ei.value.kind == "deadline-unmeetable"
+    assert ei.value.retry_after_ms is None
+
+
+def test_hint_on_fifo_queue_full_and_timeout():
+    """The FIFO (non-QoS) path carries the same hints."""
+    mgr = QueryManager(max_concurrent=1, queue_depth=0,
+                       admission_timeout_ms=60)
+    hog = mgr.admit()
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr.admit()
+    assert ei.value.kind == "queue-full"
+    assert ei.value.retry_after_ms is not None
+    mgr.finish(hog)
+    mgr2 = QueryManager(max_concurrent=1, queue_depth=4,
+                        admission_timeout_ms=60)
+    hog2 = mgr2.admit()
+    with pytest.raises(QueryRejectedError) as ei:
+        mgr2.admit()
+    assert ei.value.kind == "admission-timeout"
+    assert ei.value.retry_after_ms is not None
+    mgr2.finish(hog2)
+
+
+# ---------------------------------------------------------------------------
+# Resize-at-idle must not drop queued tickets (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+def test_resize_at_idle_redirects_stale_references():
+    """A caller holding the OLD manager reference across a conf-change
+    resize must land its ticket in the LIVE manager, never a retired
+    one — admit/finish/note_pressure all follow the successor chain."""
+    from spark_rapids_tpu.api.dataframe import TpuSession
+
+    def conf_for(n):
+        s = TpuSession()
+        s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", n)
+        return s.conf
+
+    with SC._MANAGER_LOCK:
+        SC._MANAGER = None
+    try:
+        old = SC.get_query_manager(conf_for(2))
+        # Idle resize retires `old` and installs a successor.
+        new = SC.get_query_manager(conf_for(3))
+        assert new is not old
+        assert old._successor is new
+        # A ticket admitted through the STALE reference lands in (and
+        # is visible to) the live manager.
+        t = old.admit()
+        assert new.active_count == 1
+        assert old._active == {}
+        old.finish(t)
+        assert new.active_count == 0
+        # The retired manager never resurrects: repeated stale calls
+        # keep following the chain even two resizes later.
+        newer = SC.get_query_manager(conf_for(4))
+        t2 = old.admit()
+        assert newer.active_count == 1
+        old.finish(t2)
+        assert newer.active_count == 0
+    finally:
+        with SC._MANAGER_LOCK:
+            SC._MANAGER = None
+
+
+def test_resize_skipped_while_active():
+    """The flip side: a manager with in-flight work never resizes —
+    the bound cannot change under a running query."""
+    from spark_rapids_tpu.api.dataframe import TpuSession
+
+    def conf_for(n):
+        s = TpuSession()
+        s.set("spark.rapids.sql.scheduler.maxConcurrentQueries", n)
+        return s.conf
+
+    with SC._MANAGER_LOCK:
+        SC._MANAGER = None
+    try:
+        mgr = SC.get_query_manager(conf_for(2))
+        t = mgr.admit()
+        same = SC.get_query_manager(conf_for(5))
+        assert same is mgr and mgr._successor is None
+        mgr.finish(t)
+    finally:
+        with SC._MANAGER_LOCK:
+            SC._MANAGER = None

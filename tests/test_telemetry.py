@@ -129,6 +129,29 @@ def test_openmetrics_rendering_golden():
     assert text.endswith("# EOF\n")
 
 
+def test_openmetrics_overload_plane_series_golden():
+    """The overload-survival series (ISSUE 18) scrape as first-class
+    OpenMetrics: preemptions and client retries as counters (_total
+    suffix, class/kind labels), pressure score and brownout state as
+    gauges."""
+    telemetry.configure(True)
+    telemetry.inc("srt_preemptions", **{"class": "background"})
+    telemetry.set_gauge("srt_pressure_score", 0.42)
+    telemetry.set_gauge("srt_brownout_active", 1)
+    telemetry.inc("srt_client_retries", kind="queue-full")
+    telemetry.inc("srt_client_retries", kind="brownout")
+    text = telemetry.render_text()
+    assert "# TYPE srt_preemptions counter" in text
+    assert "# TYPE srt_pressure_score gauge" in text
+    assert "# TYPE srt_brownout_active gauge" in text
+    assert "# TYPE srt_client_retries counter" in text
+    assert 'srt_preemptions_total{class="background"} 1' in text
+    assert "srt_pressure_score 0.42" in text
+    assert "srt_brownout_active 1" in text
+    assert 'srt_client_retries_total{kind="queue-full"} 1' in text
+    assert 'srt_client_retries_total{kind="brownout"} 1' in text
+
+
 def test_metric_kind_is_sticky():
     telemetry.configure(True)
     telemetry.inc("srt_t_kind")
