@@ -291,5 +291,124 @@ def telemetry_overhead(calls: int = 200_000, budget_ns: float = 3000.0):
         f"no-op telemetry costs {disabled:.0f} ns/call (> {budget_ns})"
 
 
+def host_bench(n: int = 200_000, iters: int = 3):
+    """Duel the vectorized host-engine kernels against the per-row
+    python loops they replaced (the r06 host path). Each pair computes
+    the same result; the loop twin is the removed implementation kept
+    here as a benchmark fossil so the speedup stays measurable."""
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.columnar.host import (
+        HostBatch, HostColumn, encode_key, strings_to_matrix)
+    from spark_rapids_tpu.ops.sort import SortOrder, host_sort_indices
+    from spark_rapids_tpu.exprs.base import BoundReference as Ref
+
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, n // 50, n)
+    vals = rng.uniform(0, 1e4, n)
+    words = np.array([b"alpha", b"bravo", b"charlie", b"delta", b"echo"],
+                     dtype=object)
+    svals = words[rng.integers(0, 5, n)]
+
+    def duel(name, vec, loop):
+        tv = min(_wall(vec) for _ in range(iters))
+        tl = _wall(loop)    # once is enough, it's the slow one
+        print(f"host {name}: vectorized={tv*1000:.1f} ms "
+              f"loop={tl*1000:.1f} ms speedup={tl/max(tv,1e-9):.1f}x")
+
+    # 1. string column -> byte matrix (scan/shuffle boundary).
+    def enc_vec():
+        col = HostColumn(dt.STRING, svals.copy(),
+                         np.ones(n, np.bool_))
+        return strings_to_matrix(col)
+
+    def enc_loop():
+        lens = np.zeros(n, np.int32)
+        w = max(len(v) for v in svals)
+        m = np.zeros((n, w), np.uint8)
+        for i, v in enumerate(svals):
+            lens[i] = len(v)
+            m[i, :len(v)] = np.frombuffer(v, np.uint8)
+        return m, lens
+
+    duel("string-encode", enc_vec, enc_loop)
+
+    # 2. order-preserving sort keys: lexsort vs python sorted.
+    hb = HostBatch(("k", "v"), [
+        HostColumn(dt.INT64, keys.astype(np.int64), np.ones(n, np.bool_)),
+        HostColumn(dt.FLOAT64, vals, np.ones(n, np.bool_))])
+    orders = [SortOrder(Ref(1, dt.FLOAT64), ascending=False),
+              SortOrder(Ref(0, dt.INT64))]
+
+    def sort_vec():
+        return host_sort_indices(hb, orders)
+
+    def sort_loop():
+        rows = list(zip(vals.tolist(), keys.tolist(), range(n)))
+        rows.sort(key=lambda r: (-r[0], r[1]))
+        return [r[2] for r in rows]
+
+    duel("sort-keys", sort_vec, sort_loop)
+
+    # 3. grouped sum: encode+lexsort+reduceat vs dict accumulate.
+    def agg_vec():
+        kc = HostColumn(dt.INT64, keys.astype(np.int64),
+                        np.ones(n, np.bool_))
+        code = encode_key(kc)
+        order = np.argsort(code, kind="stable")
+        sc = code[order]
+        flags = np.ones(n, np.bool_)
+        flags[1:] = sc[1:] != sc[:-1]
+        starts = np.flatnonzero(flags)
+        return np.add.reduceat(vals[order], starts)
+
+    def agg_loop():
+        acc = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            acc[k] = acc.get(k, 0.0) + v
+        return acc
+
+    duel("group-sum", agg_vec, agg_loop)
+
+    # 4. hash-join probe: sorted build + searchsorted vs dict probe.
+    bk = np.unique(keys)[: max(1, len(np.unique(keys)) // 2)]
+
+    def join_vec():
+        order = np.argsort(bk, kind="stable")
+        blo = np.searchsorted(bk[order], keys, "left")
+        bhi = np.searchsorted(bk[order], keys, "right")
+        return np.flatnonzero(bhi > blo)
+
+    def join_loop():
+        bset = set(bk.tolist())
+        return [i for i, k in enumerate(keys.tolist()) if k in bset]
+
+    duel("join-probe", join_vec, join_loop)
+
+    # 5. fused filter mask-then-gather vs per-row append.
+    def filt_vec():
+        keep = vals < 5e3
+        return vals[keep], keys[keep]
+
+    def filt_loop():
+        ov, ok_ = [], []
+        for i in range(n):
+            if vals[i] < 5e3:
+                ov.append(vals[i])
+                ok_.append(keys[i])
+        return ov, ok_
+
+    duel("filter-gather", filt_vec, filt_loop)
+
+
+def _wall(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "host":
+        host_bench()
+    else:
+        main()

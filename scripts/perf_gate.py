@@ -177,6 +177,41 @@ def measure_telemetry_noop_ns(calls: int = 200_000) -> float:
     return best
 
 
+def measure_host_engine_s() -> float:
+    """Forced-host q1+q3 wall through the vectorized numpy engine —
+    scan+filter+agg plus a two-join pipeline, the shapes the r06
+    profile showed dominated by per-row python loops. Gated so a loop
+    sneaking back into the sort/agg/join/filter host halves (or a
+    matrix-destroying string copy) fails CI, same tolerance machinery
+    as the device headlines."""
+    from spark_rapids_tpu.api.dataframe import TpuSession
+    from spark_rapids_tpu.benchmarks import tpch
+    sf = float(os.environ.get("WARMUP_SF", "0.01"))
+    tpch_dir = os.environ.get("TPCH_DIR", f"/tmp/srt_tpch_sf{sf:g}")
+    if not os.path.isdir(tpch_dir):
+        tpch.generate(tpch_dir, scale=sf)
+
+    def session():
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        s.set("spark.rapids.sql.hasNans", False)
+        return s
+
+    dfs = [tpch.QUERIES[q](session(), tpch_dir) for q in ("q1", "q3")]
+    for df in dfs:
+        df.collect_host()           # warm imports + scan path
+
+    def sample():
+        t0 = time.perf_counter()
+        for df in dfs:
+            df.collect_host()
+        return time.perf_counter() - t0
+
+    # Best-of-3: the warm wall at this scale is tens of ms, so a single
+    # sample is scheduler-jitter-dominated on small CI machines.
+    return min(sample() for _ in range(3))
+
+
 TELEMETRY_NOOP_BUDGET_NS = 3000.0
 
 
@@ -186,13 +221,14 @@ def measure() -> dict:
     out.update(measure_compile_s())
     out["bind_only_ms"] = round(measure_bind_only_ms(), 3)
     out["scan_gbps"] = round(measure_scan_gbps(), 4)
+    out["host_engine_s"] = round(measure_host_engine_s(), 3)
     out["telemetry_noop_ns"] = round(measure_telemetry_noop_ns(), 1)
     return out
 
 
 # metric -> direction ("lower" = regression when it grows)
 GATED = {"compile_s": "lower", "bind_only_ms": "lower",
-         "scan_gbps": "higher"}
+         "scan_gbps": "higher", "host_engine_s": "lower"}
 
 
 def compare(measured: dict, reference: dict, tolerance: float) -> dict:

@@ -198,6 +198,13 @@ COST_DEVICE_GBPS = conf("spark.rapids.sql.cost.deviceThroughputGBps").doc(
     "upload + kernels with the scan cache warm) used for the "
     "bytes-proportional term of the device estimate.").double(2.0)
 
+COST_ASSUME_TUNNEL = conf("spark.rapids.sql.cost.assumeTunnel").doc(
+    "Test/bench hook: charge the device sync floor even when the "
+    "backend is CPU-only (where effective_sync_floor_ms otherwise "
+    "zeroes it — no tunnel, no per-dispatch sync cost), so placement "
+    "scenarios calibrated for real hardware can be exercised "
+    "locally.").internal().boolean(False)
+
 COST_HOST_GBPS = conf("spark.rapids.sql.cost.hostThroughputGBps").doc(
     "Calibrated host (numpy) engine throughput per operator pass used "
     "for the bytes-proportional term of the host estimate.").double(0.6)
@@ -533,6 +540,16 @@ KERNEL_CACHE_MAX_ENTRIES = conf(
     "default) — cross it and the next compile SIGSEGVs inside XLA. 512 "
     "keeps a fully-fat cache near ~40k maps; raise it only with a "
     "raised map ceiling.").integer(512)
+
+HOST_CLOSURE_CACHE_MAX_ENTRIES = conf(
+    "spark.rapids.sql.host.closureCache.maxEntries").doc(
+    "LRU bound on the host engine's compiled-closure cache "
+    "(ops/host_cache.py) — the numpy analog of the device kernel "
+    "cache, keyed by the same structural expression fingerprint + "
+    "bind-slot normalization so plan-cache bind-only executions walk "
+    "no expression tree on host either. Entries are plain python "
+    "closures (no XLA executables), so the bound only caps fingerprint "
+    "bookkeeping memory.").integer(256)
 
 DEVICE_BUDGET_BYTES = conf("spark.rapids.memory.tpu.budgetBytes").doc(
     "Explicit HBM budget for the buffer catalog in bytes; 0 derives it "

@@ -116,15 +116,22 @@ def expand_scalar(s: Scalar, capacity: int, row_mask: jnp.ndarray,
 
 
 def expand_scalar_host(s: Scalar, n: int) -> HostColumn:
+    from spark_rapids_tpu.columnar.host import all_valid
+    validity = all_valid(n) if not s.is_null \
+        else np.zeros(n, dtype=np.bool_)
     if s.dtype.is_string:
-        data = np.empty(n, dtype=object)
         b = b"" if s.is_null else s.as_bytes()
-        for i in range(n):
-            data[i] = b
-        return HostColumn(s.dtype, data,
-                          np.full(n, not s.is_null, dtype=np.bool_))
+        data = np.empty(n, dtype=object)
+        data[:] = b
+        lens = np.zeros(n, np.int32) if s.is_null else \
+            np.full(n, len(b), np.int32)
+        m = np.zeros((n, max(len(b), 1)), np.uint8)
+        if b and not s.is_null:
+            m[:] = np.frombuffer(b, dtype=np.uint8)[None, :]
+        return HostColumn(s.dtype, data, validity,
+                          str_matrix=m, str_lengths=lens)
     data = np.full(n, 0 if s.is_null else s.value, dtype=s.dtype.np_dtype)
-    return HostColumn(s.dtype, data, np.full(n, not s.is_null, dtype=np.bool_))
+    return HostColumn(s.dtype, data, validity)
 
 
 def as_device_column(v: ColumnLike, batch: DeviceBatch,
@@ -152,15 +159,17 @@ def make_column(dtype: DataType, data, validity,
 
 
 def make_host_column(dtype: DataType, data, validity) -> HostColumn:
+    validity = np.asarray(validity, dtype=np.bool_)
     if not dtype.is_string:
         data = np.asarray(data).astype(dtype.np_dtype, copy=True)
         data[~validity] = np.zeros(1, dtype.np_dtype)
     else:
         out = np.empty(len(data), dtype=object)
-        for i in range(len(data)):
-            out[i] = data[i] if validity[i] else b""
+        out[:] = data
+        if not validity.all():
+            out[~validity] = b""
         data = out
-    return HostColumn(dtype, data, np.asarray(validity, dtype=np.bool_))
+    return HostColumn(dtype, data, validity)
 
 
 # ---------------------------------------------------------------------------

@@ -249,15 +249,48 @@ def _parse_value(b: bytes, to: DataType):
     raise TypeError(f"cannot parse to {to}")
 
 
+def _format_column(col, src: DataType):
+    """Vectorized bool/int/date formatting straight into the string
+    byte-matrix (numpy S-dtype arrays are already fixed-width NUL-padded
+    row buffers). Floats and timestamps keep the exact per-row
+    Double.toString mimicry. Returns None when not vectorizable."""
+    n = col.num_rows
+    if n == 0:
+        return None
+    arr = np.asarray(col.data)
+    if src.is_boolean:
+        s = np.where(arr.astype(np.bool_), np.asarray(b"true", "S5"),
+                     np.asarray(b"false", "S5"))
+    elif src.is_integral:
+        s = np.char.mod(b"%d", arr.astype(np.int64))
+    elif src.name == "date":
+        days = arr.astype(np.int64).astype("datetime64[D]")
+        s = np.char.encode(np.datetime_as_string(days, unit="D"))
+    else:
+        return None
+    return np.ascontiguousarray(s)
+
+
 def _cast_string_host(col, src: DataType, to: DataType):
     """HostColumn cast where either side is a string."""
     from spark_rapids_tpu.columnar.host import HostColumn
     n = col.num_rows
     if to.is_string:
+        validity = np.asarray(col.validity, np.bool_)
+        s = _format_column(col, src)
+        if s is not None:
+            w = max(s.dtype.itemsize, 1)
+            m = np.frombuffer(s.tobytes(), np.uint8).reshape(n, w)
+            m = m * validity[:, None].astype(np.uint8)
+            lens = np.char.str_len(s).astype(np.int32)
+            lens = np.where(validity, lens, 0).astype(np.int32)
+            return HostColumn(to, None, validity.copy(),
+                              str_matrix=m, str_lengths=lens)
         data = np.empty(n, dtype=object)
         validity = col.validity.copy()
+        cdata = col.data
         for i in range(n):
-            data[i] = _format_value(col.data[i], src) if validity[i] else b""
+            data[i] = _format_value(cdata[i], src) if validity[i] else b""
         return HostColumn(to, data, validity)
     # string -> typed
     data = np.zeros(n, dtype=to.np_dtype)

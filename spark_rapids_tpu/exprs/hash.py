@@ -23,6 +23,7 @@ from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
+from spark_rapids_tpu.columnar.host import all_valid as _all_valid
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.dtypes import DataType
@@ -306,20 +307,17 @@ class Md5(Expression):
         return make_column(dt.STRING, hexm, validity, lengths)
 
     def eval_host(self, batch):
-        import hashlib
+        from spark_rapids_tpu.columnar.host import (
+            HostColumn, strings_to_matrix)
         child = self._children[0]
         hc = as_host_column(child.eval_host(batch), batch)
-        n = batch.num_rows
-        out = np.empty(n, dtype=object)
-        for i in range(n):
-            if hc.validity[i]:
-                v = hc.data[i]
-                raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
-                out[i] = hashlib.md5(raw).hexdigest().encode("ascii")
-            else:
-                out[i] = b""
-        return make_host_column(dt.STRING, out,
-                                np.asarray(hc.validity, np.bool_))
+        m, lens = strings_to_matrix(hc)
+        hexm = np.asarray(md5_hex_matrix(np, m, lens), np.uint8)
+        validity = np.asarray(hc.validity, np.bool_)
+        hexm = hexm * validity[:, None].astype(np.uint8)
+        lengths = np.where(validity, 32, 0).astype(np.int32)
+        return HostColumn(dt.STRING, None, validity,
+                          str_matrix=hexm, str_lengths=lengths)
 
 
 class Murmur3Hash(Expression):
@@ -360,4 +358,4 @@ class Murmur3Hash(Expression):
                 cols.append((hc, c.data_type()))
         data = self._run(np, cols, batch.num_rows)
         return make_host_column(dt.INT32, data,
-                                np.ones(batch.num_rows, np.bool_))
+                                _all_valid(batch.num_rows))

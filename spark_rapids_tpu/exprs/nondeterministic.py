@@ -27,6 +27,7 @@ from typing import Any, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
+from spark_rapids_tpu.columnar.host import all_valid as _all_valid
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.dtypes import DataType
@@ -97,7 +98,7 @@ class SparkPartitionID(ContextualExpression):
         n = batch.num_rows
         return make_host_column(
             dt.INT32, np.full(n, int(ctx.partition_id), np.int32),
-            np.ones(n, np.bool_))
+            _all_valid(n))
 
     def pretty(self) -> str:
         return "spark_partition_id()"
@@ -125,7 +126,7 @@ class MonotonicallyIncreasingID(ContextualExpression):
         n = batch.num_rows
         idx = int(ctx.row_base) + np.arange(n, dtype=np.int64)
         val = (np.int64(int(ctx.partition_id)) << np.int64(33)) + idx
-        return make_host_column(dt.INT64, val, np.ones(n, np.bool_))
+        return make_host_column(dt.INT64, val, _all_valid(n))
 
     def pretty(self) -> str:
         return "monotonically_increasing_id()"
@@ -201,7 +202,7 @@ class Rand(ContextualExpression):
         pid = np.int64(int(ctx.partition_id))
         idx = np.int64(int(ctx.row_base)) + np.arange(n, dtype=np.int64)
         u = _uniform(np, self.seed, pid, idx)
-        return make_host_column(dt.FLOAT64, u, np.ones(n, np.bool_))
+        return make_host_column(dt.FLOAT64, u, _all_valid(n))
 
     def pretty(self) -> str:
         return f"rand({self.seed})"

@@ -16,6 +16,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.dtypes import DataType
+from spark_rapids_tpu.columnar.host import all_valid as _all_valid
 from spark_rapids_tpu.exprs.base import (
     BinaryExpression, Expression, Scalar, UnaryExpression,
     as_device_column, as_host_column, make_column, make_host_column)
@@ -149,7 +150,7 @@ class EqualNullSafe(_Comparison):
             rc = _host_strings_to_matrix(rc)
         data, _ = self._cmp_eval(np, lc, rc, None)
         return make_host_column(dt.BOOL, data,
-                                np.ones(batch.num_rows, np.bool_))
+                                _all_valid(batch.num_rows))
 
 
 class Not(UnaryExpression):
@@ -199,7 +200,7 @@ class IsNull(UnaryExpression):
     def eval_host(self, batch):
         col = as_host_column(self.child.eval_host(batch), batch)
         return make_host_column(dt.BOOL, ~col.validity,
-                                np.ones(batch.num_rows, np.bool_))
+                                _all_valid(batch.num_rows))
 
     def do_columnar(self, xp, data, validity, col):  # pragma: no cover
         raise AssertionError
@@ -216,7 +217,7 @@ class IsNotNull(UnaryExpression):
     def eval_host(self, batch):
         col = as_host_column(self.child.eval_host(batch), batch)
         return make_host_column(dt.BOOL, col.validity,
-                                np.ones(batch.num_rows, np.bool_))
+                                _all_valid(batch.num_rows))
 
     def do_columnar(self, xp, data, validity, col):  # pragma: no cover
         raise AssertionError
@@ -273,7 +274,7 @@ class AtLeastNNonNulls(Expression):
         if acc is None:
             acc = np.zeros(batch.num_rows, np.int32)
         return make_host_column(dt.BOOL, acc >= self.n,
-                                np.ones(batch.num_rows, np.bool_))
+                                _all_valid(batch.num_rows))
 
 
 class InSet(Expression):

@@ -24,6 +24,7 @@ from typing import Tuple
 
 import jax.numpy as jnp
 import numpy as np
+from spark_rapids_tpu.columnar.host import all_valid as _host_all_valid
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.dtypes import DataType
@@ -730,14 +731,14 @@ class ConcatWs(Expression):
         if not self._children:
             n = batch.num_rows
             out = np.full(n, b"", dtype=object)
-            return HostColumn(dt.STRING, out, np.ones(n, np.bool_))
+            return HostColumn(dt.STRING, out, _host_all_valid(n))
         cols = []
         for c in self._children:
             col = as_host_column(c.eval_host(batch), batch)
             m, lens = _host_to_matrix(col)
             cols.append((m, lens, col.validity))
         data, lengths = self._run(np, cols)
-        valid = np.ones((len(lengths),), np.bool_)
+        valid = np.asarray(_host_all_valid(len(lengths)))
         return _matrix_to_host(data, lengths, valid)
 
 

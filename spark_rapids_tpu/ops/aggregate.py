@@ -1370,8 +1370,183 @@ class HashAggregateExec(Exec):
             groups[key] = [per_agg[ai][gi] for ai in range(nags)]
         return order, key_values, groups
 
+    # -- vectorized host engine ---------------------------------------------
+    def _host_segments(self, key_pieces, total):
+        """Group segmentation over per-batch key column pieces: one stable
+        lexsort over (encode_key_concat, validity) planes per key. Returns
+        ``(order_idx, starts, ends, emit, rep_idx, key_enc)`` where
+        starts/ends are ascending (reduceat currency), ``emit`` permutes
+        sorted-group order into first-seen emission order, ``rep_idx``
+        is each group's first original row in emission order, and
+        ``key_enc`` is the per-key ``(codes, space)`` list — the caller
+        stamps these onto the concatenated key columns so the encoding
+        survives into this aggregate's OUTPUT and the next consumer
+        (shuffle -> final agg) merges dictionaries instead of
+        re-ranking rows.
+
+        Keys arrive as the UNCONCATENATED per-batch pieces so encoding
+        can dedupe repeated column instances (grouping-set expansion)
+        instead of re-ranking the materialized concat."""
+        from spark_rapids_tpu.columnar.host import encode_key_concat
+        nkeys = len(key_pieces)
+        if nkeys == 0:
+            order_idx = np.arange(total, dtype=np.int64)
+            one = np.zeros(1, np.int64)
+            return (order_idx, one, np.asarray([total], np.int64), one,
+                    one.copy(), [])
+        codes, valids, spaces = [], [], []
+        for pieces in key_pieces:
+            c, v, space = encode_key_concat(pieces)
+            codes.append(c)
+            valids.append(v.view(np.int8))
+            spaces.append(space)
+        # Pack (valid, code) pairs into as few int64 planes as their
+        # value ranges allow: a 9-key rollup that would lexsort and
+        # diff-scan 18 planes usually fits in one packed word (string
+        # codes are dense ranks, int keys span small ranges). Packing is
+        # injective per key, so segment contiguity and the stable
+        # within-group order are exactly those of the unpacked sort —
+        # only the (irrelevant, emit-normalized) group order changes.
+        planes: list = []
+        acc = None
+        acc_range = 1
+        _cap = 1 << 62
+        for ki in range(nkeys):
+            c, v = codes[ki], valids[ki].astype(np.int64)
+            cmin = int(c.min())
+            crange = int(c.max()) - cmin + 1
+            r = 2 * crange
+            if r > _cap:
+                if acc is not None:
+                    planes.append(acc)
+                    acc, acc_range = None, 1
+                planes.append(v)        # valid outranks code (null group)
+                planes.append(c)
+                continue
+            local = v * crange + (c - cmin)
+            if acc is None:
+                acc, acc_range = local, r
+            elif acc_range * r <= _cap:
+                acc = acc * r + local
+                acc_range *= r
+            else:
+                planes.append(acc)
+                acc, acc_range = local, r
+        if acc is not None:
+            planes.append(acc)
+        from spark_rapids_tpu.columnar.host import stable_code_argsort
+        order_idx = stable_code_argsort(planes[0]) if len(planes) == 1 \
+            else np.lexsort(tuple(planes[::-1]))
+        new_flags = np.zeros(total, dtype=bool)
+        new_flags[0] = True
+        for p in planes:
+            sp = p[order_idx]
+            new_flags[1:] |= sp[1:] != sp[:-1]
+        starts = np.flatnonzero(new_flags).astype(np.int64)
+        ends = np.append(starts[1:], total)
+        emit = np.argsort(order_idx[starts], kind="stable").astype(np.int64)
+        rep_idx = order_idx[starts][emit]
+        return (order_idx, starts, ends, emit, rep_idx,
+                list(zip(codes, spaces)))
+
+    def _host_exec_vectorized(self, hbs):
+        """One vectorized pass covering every host aggregation mode
+        (update/complete over inputs, merge/final over buffers,
+        mixed_final), or None when the shape doesn't qualify (empty
+        input, string min/max, an agg without a segment kernel) — the
+        per-row python grouping below stays as the oracle fallback."""
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        total = sum(hb.num_rows for hb in hbs)
+        if total == 0:
+            return None
+        for spec in self.aggs:
+            fn = spec.fn
+            if isinstance(fn, (Count, Average, Sum, First)):
+                continue
+            if isinstance(fn, Min):
+                if fn.child.data_type().is_string:
+                    return None
+                continue
+            return None
+
+        def concat_col(cols):
+            if len(cols) == 1:
+                return cols[0]
+            return concat_host_batches(
+                [HostBatch(("c",), [c]) for c in cols]).columns[0]
+
+        mode = self.mode
+        agg_inputs = []
+        if mode in ("partial", "complete"):
+            kind = "update" if mode == "partial" else "agg"
+            keysrc = [[as_host_column(e.eval_host(hb), hb)
+                       for e in self.group_exprs] for hb in hbs]
+            for spec in self.aggs:
+                if spec.fn.child is None:
+                    agg_inputs.append((kind, [None]))
+                else:
+                    agg_inputs.append((kind, [concat_col(
+                        [as_host_column(spec.fn.child.eval_host(hb), hb)
+                         for hb in hbs])]))
+        elif mode in ("final", "merge"):
+            kind = "final" if mode == "final" else "merge"
+            keysrc = [list(hb.columns[:self._nkeys]) for hb in hbs]
+            ci = self._nkeys
+            for spec in self.aggs:
+                nbuf = len(spec.fn.buffer_types)
+                agg_inputs.append((kind, [
+                    concat_col([hb.columns[ci + b] for hb in hbs])
+                    for b in range(nbuf)]))
+                ci += nbuf
+        else:                                   # mixed_final
+            keysrc = [list(hb.columns[:self._nkeys]) for hb in hbs]
+            xcol = concat_col([hb.columns[self._nkeys] for hb in hbs])
+            ci = self._nkeys + 1
+            for spec in self.aggs:
+                if spec.distinct:
+                    agg_inputs.append(("agg", [xcol]))
+                else:
+                    nbuf = len(spec.fn.buffer_types)
+                    agg_inputs.append(("final", [
+                        concat_col([hb.columns[ci + b] for hb in hbs])
+                        for b in range(nbuf)]))
+                    ci += nbuf
+        key_cols = [concat_col([ks[ki] for ks in keysrc])
+                    for ki in range(self._nkeys)]
+        (order_idx, starts, ends, emit, rep_idx,
+         key_enc) = self._host_segments(
+            [[ks[ki] for ks in keysrc] for ki in range(self._nkeys)],
+            total)
+        for kc, (codes, space) in zip(key_cols, key_enc):
+            # The concat rows ARE the rows these codes were computed
+            # for; stamping lets take(rep_idx) below propagate them.
+            if kc._key_codes is None:
+                kc._key_codes = codes
+                kc._key_uniq = space
+        out_cols = []
+        for kc in key_cols:
+            oc = kc.take(rep_idx)
+            if oc.dtype.is_floating:
+                # Canonical zero on output: -0.0 group reps emit as 0.0
+                # (grouping already treats them equal).
+                oc = HostColumn(oc.dtype,
+                                oc.data + oc.dtype.np_dtype.type(0),
+                                oc.validity)
+            out_cols.append(oc)
+        for (kind, cols), spec in zip(agg_inputs, self.aggs):
+            res = _host_seg_agg(spec.fn, kind, cols, order_idx, starts,
+                                ends, total)
+            if res is None:
+                return None
+            out_cols.extend(rc.take(emit) for rc in res)
+        return HostBatch(tuple(n for n, _ in self.schema), out_cols)
+
     def execute_host(self, ctx, partition):
         hbs = list(self.children[0].execute_host(ctx, partition))
+        fast = self._host_exec_vectorized(hbs)
+        if fast is not None:
+            yield fast
+            return
         if self.mode in ("final", "merge"):
             yield from self._execute_host_final(
                 hbs, do_finalize=self.mode == "final")
@@ -1515,3 +1690,160 @@ def _rows_to_host_batch(rows: List[tuple], schema: Schema) -> HostBatch:
         vals = [r[ci] for r in rows]
         cols.append(HostColumn.from_values(t, vals))
     return HostBatch(names, cols)
+
+
+def _host_seg_agg(fn: AggFunction, kind: str, cols, order_idx, starts,
+                  ends, total) -> Optional[List[HostColumn]]:
+    """Vectorized per-group evaluation of one aggregate over sorted
+    segments — the numpy mirror of the fn's host_update/host_agg/
+    host_merge/host_finalize contract, one reduceat per group set
+    instead of one python call per group.
+
+    ``kind``: 'agg' (complete result), 'update' (partial buffers),
+    'merge' (merged buffers, unfinalized), 'final' (merge + finalize).
+    ``cols`` holds the concatenated input column ('agg'/'update'; None
+    for count(*)) or the buffer columns ('merge'/'final'). Results come
+    back in SORTED-group order (the caller permutes by its emission
+    order). None = no segment kernel for this fn/dtype (caller falls
+    back to the python path)."""
+    ngroups = len(starts)
+
+    def v_of(c):
+        return np.asarray(c.validity, np.bool_)[order_idx]
+
+    def d_of(c):
+        return np.asarray(c.data)[order_idx]
+
+    def cnt_of(v):
+        return np.add.reduceat(v.astype(np.int64), starts)
+
+    def masked_sum(c, out_float):
+        v = v_of(c)
+        if out_float:
+            return np.add.reduceat(
+                np.where(v, d_of(c).astype(np.float64), 0.0), starts), v
+        with np.errstate(over="ignore"):
+            s = np.add.reduceat(
+                np.where(v, d_of(c).astype(np.int64), np.int64(0)), starts)
+        return s, v
+
+    if isinstance(fn, CountStar) and kind in ("agg", "update"):
+        return [HostColumn(dt.INT64, (ends - starts).astype(np.int64),
+                           np.ones(ngroups, np.bool_))]
+    if isinstance(fn, Count):           # Count + CountStar merge/final
+        if kind in ("agg", "update"):
+            data = cnt_of(v_of(cols[0]))
+        else:
+            data, _ = masked_sum(cols[0], out_float=False)
+        return [HostColumn(dt.INT64, data, np.ones(ngroups, np.bool_))]
+
+    if isinstance(fn, Sum):
+        t = fn.result_type
+        s, v = masked_sum(cols[0], out_float=t.is_floating)
+        ok = cnt_of(v) > 0
+        data = np.where(ok, s, 0).astype(t.np_dtype)
+        return [HostColumn(t, data, ok)]
+
+    if isinstance(fn, Average):
+        if kind in ("agg", "update"):
+            s, v = masked_sum(cols[0], out_float=True)
+            n = cnt_of(v)
+            sv = n > 0
+        else:
+            s, v0 = masked_sum(cols[0], out_float=True)
+            n, _ = masked_sum(cols[1], out_float=False)
+            sv = cnt_of(v0) > 0
+        if kind in ("agg", "final"):
+            ok = n > 0
+            data = np.where(ok, s / np.where(ok, n, 1), 0.0)
+            return [HostColumn(dt.FLOAT64, data, ok)]
+        return [HostColumn(dt.FLOAT64, np.where(sv, s, 0.0), sv),
+                HostColumn(dt.INT64, n, np.ones(ngroups, np.bool_))]
+
+    if isinstance(fn, Min):             # Min + Max, numeric only
+        c = cols[0]
+        t = c.dtype
+        if t.is_string:
+            return None
+        v = v_of(c)
+        ok = cnt_of(v) > 0
+        is_max = fn.kind == "max"
+        if t.is_floating:
+            f = d_of(c).astype(np.float64)
+            nanm = v & np.isnan(f)
+            nonnan = v & ~np.isnan(f)
+            if is_max:
+                # Spark max: NaN is greatest — any NaN wins the group.
+                m = np.maximum.reduceat(np.where(nonnan, f, -np.inf),
+                                        starts)
+                data = np.where(cnt_of(nanm) > 0, np.nan, m)
+            else:
+                # Spark min: NaN only when the group is all-NaN.
+                m = np.minimum.reduceat(np.where(nonnan, f, np.inf),
+                                        starts)
+                data = np.where(cnt_of(nonnan) > 0, m, np.nan)
+            data = np.where(ok, data, 0.0).astype(t.np_dtype)
+        else:
+            x = d_of(c).astype(np.int64)
+            if is_max:
+                m = np.maximum.reduceat(
+                    np.where(v, x, np.iinfo(np.int64).min), starts)
+            else:
+                m = np.minimum.reduceat(
+                    np.where(v, x, np.iinfo(np.int64).max), starts)
+            data = np.where(ok, m, 0).astype(t.np_dtype)
+        return [HostColumn(t, data, ok)]
+
+    if isinstance(fn, First):           # First + Last
+        last = fn.pick == "max"
+        pos = np.arange(total, dtype=np.int64)
+        if kind in ("agg", "update"):
+            c = cols[0]
+            v = v_of(c)
+            if fn.ignore_nulls:
+                if last:
+                    p = np.maximum.reduceat(np.where(v, pos, np.int64(-1)),
+                                            starts)
+                    ok = p >= 0
+                else:
+                    big = np.int64(total)
+                    p = np.minimum.reduceat(np.where(v, pos, big), starts)
+                    ok = p < big
+            else:
+                p = (ends - 1 if last else starts).astype(np.int64)
+                ok = np.ones(ngroups, np.bool_)
+            safe = np.where(ok, p, 0)
+            idx = np.where(ok, order_idx[safe], np.int64(-1))
+            vcol = c.take(idx, null_on_negative=True)
+            if kind == "agg":
+                return [vcol]
+            return [vcol, HostColumn(dt.INT64, np.where(ok, safe - starts, 0),
+                                     ok)]
+        # merge/final over (value, within-group-index) buffers: pick the
+        # min (First) / max (Last) index, first-wins on ties like the
+        # stable python min/max — encoded as index*T + tiebreak so one
+        # reduceat does argmin with stability.
+        vb, ib = cols
+        iv = v_of(ib)
+        ix = d_of(ib).astype(np.int64)
+        localpos = pos - np.repeat(starts, ends - starts)
+        T = np.int64(total + 1)
+        if last:
+            enc = np.where(iv, ix * T + (T - 1 - localpos), np.int64(-1))
+            best = np.maximum.reduceat(enc, starts)
+            ok = best >= 0
+        else:
+            imax = np.iinfo(np.int64).max
+            enc = np.where(iv, ix * T + localpos, imax)
+            best = np.minimum.reduceat(enc, starts)
+            ok = best < imax
+        safe = np.where(ok, best, 0)
+        lp = (T - 1) - (safe % T) if last else safe % T
+        p = starts + lp
+        idx = np.where(ok, order_idx[np.where(ok, p, 0)], np.int64(-1))
+        vcol = vb.take(idx, null_on_negative=True)
+        if kind == "final":
+            return [vcol]
+        return [vcol, HostColumn(dt.INT64, np.where(ok, safe // T, 0), ok)]
+
+    return None

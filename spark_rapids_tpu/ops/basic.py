@@ -17,7 +17,8 @@ import numpy as np
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import (
     DeviceBatch, DeviceColumn, bucket_capacity)
-from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn, \
+    all_valid as host_all_valid
 from spark_rapids_tpu.exprs.base import (
     Expression, as_device_column, as_host_column, eval_exprs,
     eval_exprs_host)
@@ -29,6 +30,53 @@ from spark_rapids_tpu.exprs.nondeterministic import (
 from spark_rapids_tpu.ops import kernel_cache as kc
 from spark_rapids_tpu.ops.base import (Exec, ExecContext, Schema,
     record_batch, timed)
+
+
+def _project_host_closure(exprs, names):
+    """Build the compiled host closure for a projection: one numpy ufunc
+    pipeline pass per batch, bound literals riding as arguments."""
+    def closure(hb: HostBatch, binds) -> HostBatch:
+        if binds is not None:
+            with bound_literals(binds):
+                return eval_exprs_host(exprs, hb, names)
+        return eval_exprs_host(exprs, hb, names)
+    return closure
+
+
+def _filter_host_closure(condition):
+    """Build the compiled host closure for a filter: fused mask-then-
+    gather — evaluate the condition once, AND in validity, and gather
+    every column through the matrix-preserving HostColumn.filter (string
+    columns keep their dense byte-matrix layout instead of decaying to
+    per-row object arrays)."""
+    def closure(hb: HostBatch, binds) -> HostBatch:
+        if binds is not None:
+            with bound_literals(binds):
+                cond = as_host_column(condition.eval_host(hb), hb)
+        else:
+            cond = as_host_column(condition.eval_host(hb), hb)
+        keep = np.asarray(cond.data, np.bool_) \
+            & np.asarray(cond.validity, np.bool_)
+        return hb.filter(keep)
+    return closure
+
+
+def _host_closure(ctx, op, kind, exprs, builder, binds):
+    """Fetch the operator's compiled host closure through the host
+    closure cache (ops/host_cache.py) — same fingerprint + bind-slot
+    normalization as the device kernel cache, so bind-only plan-cache
+    executions hit. Non-jittable expression trees (nondeterministic
+    state) skip the cache like the device path does."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.ops import host_cache as hc
+    if not all(e.jittable for e in exprs):
+        return builder()
+    fp = kc.fingerprint(tuple(exprs))
+    schema_fp = kc.schema_fingerprint(op.children[0].schema)
+    nbinds = 0 if binds is None else len(binds)
+    return hc.lookup(kind, (fp, schema_fp, nbinds), builder,
+                     ctx.metrics_for(op),
+                     ctx.conf.get(C.HOST_CLOSURE_CACHE_MAX_ENTRIES))
 
 
 def _input_file_key(op: Exec, partition: int, host: bool = False
@@ -188,13 +236,13 @@ class ProjectExec(Exec):
                 ctx, partition, self.exprs)
             return
         binds = host_bind_args(ctx) if has_bind_slots(self.exprs) else None
+        fn = _host_closure(
+            ctx, self, "project", self.exprs,
+            lambda: _project_host_closure(list(self.exprs),
+                                          tuple(self.names)),
+            binds)
         for hb in self.children[0].execute_host(ctx, partition):
-            if binds is not None:
-                with bound_literals(binds):
-                    out = eval_exprs_host(self.exprs, hb, self.names)
-                yield out
-            else:
-                yield eval_exprs_host(self.exprs, hb, self.names)
+            yield fn(hb, binds)
 
 
 class FilterExec(Exec):
@@ -220,11 +268,7 @@ class FilterExec(Exec):
         return batch.with_sel(keep)
 
     def _host_kernel(self, hb: HostBatch) -> HostBatch:
-        cond = as_host_column(self.condition.eval_host(hb), hb)
-        keep = cond.data & cond.validity
-        cols = [HostColumn(c.dtype, c.data[keep], c.validity[keep])
-                for c in hb.columns]
-        return HostBatch(hb.names, cols)
+        return _filter_host_closure(self.condition)(hb, None)
 
     def execute_device(self, ctx, partition):
         condition = self.condition
@@ -276,13 +320,11 @@ class FilterExec(Exec):
             return
         binds = host_bind_args(ctx) \
             if has_bind_slots([self.condition]) else None
+        fn = _host_closure(
+            ctx, self, "filter", [self.condition],
+            lambda: _filter_host_closure(self.condition), binds)
         for hb in self.children[0].execute_host(ctx, partition):
-            if binds is not None:
-                with bound_literals(binds):
-                    out = self._host_kernel(hb)
-                yield out
-            else:
-                yield self._host_kernel(hb)
+            yield fn(hb, binds)
 
 
 class UnionExec(Exec):
@@ -409,7 +451,7 @@ class RangeExec(Exec):
             n = min(self.batch_rows, hi - idx)
             base = self.start + idx * self.step
             data = base + np.arange(n, dtype=np.int64) * self.step
-            col = HostColumn(dt.INT64, data, np.ones(n, np.bool_))
+            col = HostColumn(dt.INT64, data, host_all_valid(n))
             yield HostBatch((self._name,), [col])
             idx += n
 

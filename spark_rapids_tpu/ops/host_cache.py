@@ -1,0 +1,64 @@
+"""Compiled host-closure cache — the numpy analog of ops/kernel_cache.
+
+The vectorized host engine builds one numpy closure per (operator kind,
+expression-tree fingerprint, input schema, bind arity). Keys use the same
+structural fingerprint + bind-slot normalization as the device kernel
+cache (kernel_cache.fingerprint folds BindSlotExpr down to its slot and
+dtype), so a plan-cache bind-only execution re-traces nothing on host
+either: the closure comes back from the cache and only the bound literal
+values change.
+
+Unlike device kernels there is no compile step to amortize — what the
+cache buys is (a) one expression-tree fingerprint walk per operator
+instead of per batch, (b) the shared counters (hostClosureCacheHits /
+hostClosureCacheMisses) that make host-path cache behavior observable
+next to the device kernel cache's, and (c) one place to hang future
+host-side expression compilation.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional, Tuple
+
+DEFAULT_MAX_ENTRIES = 256
+
+_LOCK = threading.RLock()
+_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
+
+
+def lookup(kind: str, key_parts: Tuple, builder: Callable[[], Callable],
+           metrics=None,
+           max_entries: Optional[int] = None) -> Callable:
+    """Return the cached closure for ``(kind, *key_parts)``, building and
+    inserting it on a miss. LRU-bounded by ``max_entries`` (conf
+    ``spark.rapids.sql.host.closureCache.maxEntries``)."""
+    key = (kind,) + tuple(key_parts)
+    with _LOCK:
+        fn = _CACHE.get(key)
+        if fn is not None:
+            _CACHE.move_to_end(key)
+            if metrics is not None:
+                metrics.add("hostClosureCacheHits", 1)
+            return fn
+    fn = builder()
+    cap = DEFAULT_MAX_ENTRIES if max_entries is None else int(max_entries)
+    with _LOCK:
+        if metrics is not None:
+            metrics.add("hostClosureCacheMisses", 1)
+        _CACHE[key] = fn
+        _CACHE.move_to_end(key)
+        while len(_CACHE) > max(1, cap):
+            _CACHE.popitem(last=False)
+    return fn
+
+
+def clear() -> None:
+    with _LOCK:
+        _CACHE.clear()
+
+
+def size() -> int:
+    with _LOCK:
+        return len(_CACHE)

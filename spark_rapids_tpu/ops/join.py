@@ -1096,126 +1096,225 @@ def _empty_like(schema: Schema) -> DeviceBatch:
     return DeviceBatch(tuple(cols), jnp.asarray(0, jnp.int32))
 
 
+def _empty_host_batch(schema: Schema) -> HostBatch:
+    cols = []
+    for _, t in schema:
+        if t.is_string:
+            cols.append(HostColumn(t, None, np.zeros(0, np.bool_),
+                                   str_matrix=np.zeros((0, 1), np.uint8),
+                                   str_lengths=np.zeros(0, np.int32)))
+        else:
+            cols.append(HostColumn(t, np.zeros(0, t.np_dtype),
+                                   np.zeros(0, np.bool_)))
+    return HostBatch(tuple(n for n, _ in schema), cols)
+
+
 def _host_join(op, ctx, partition, nested_loop: bool = False):
-    """Host join with SQL equi-join null semantics. Equi-joins probe a
-    dict index over the build side (O(n+m) — the host engine is a
-    first-class placement target now, plan/cost.py, so this path must
-    not be quadratic); nested-loop joins keep the O(n*m) scan their
-    arbitrary conditions require."""
-    def _collect(child):
-        out = []
-        for cp in range(child.num_partitions(ctx)):
-            for hb in child.execute_host(ctx, cp):
-                out.extend(hb.to_pylist())
+    """Vectorized host join with SQL equi-join null semantics.
+
+    Equi-joins reduce each key tuple to one int64 code per row (shared
+    code space across sides, NaN==NaN and -0.0==0.0 canonical —
+    columnar/host.py encode_key_pair), sort the build side by code, and
+    probe every left row with two searchsorted calls; pair expansion is
+    one repeat+gather, conditions evaluate ONCE over the gathered pair
+    batch, and every emission mode is an index-array gather (negative
+    index = null extension). That keeps the exact emission order of the
+    row loop this replaced: pairs per left row with build rows
+    ascending, unmatched right rows appended at the end. Nested-loop
+    joins expand the cross product in bounded chunks with the same
+    vectorized condition eval."""
+    from spark_rapids_tpu.columnar.host import (
+        concat_host_batches, encode_key_pair, stable_code_argsort)
+
+    def _collect(child, parts, cache_tag=None):
+        # Broadcast sides span EVERY child partition; without a cache
+        # each probe partition would re-execute the whole build subtree
+        # (scans, upstream joins and all) — collect once per query like
+        # the device path's broadcast collection.
+        key = None
+        if cache_tag is not None:
+            key = f"bcast-host:{id(op):x}:{cache_tag}"
+            hit = ctx.cache.get(key)
+            if hit is not None:
+                return hit
+        hbs = []
+        for cp in parts:
+            hbs.extend(child.execute_host(ctx, cp))
+        out = (concat_host_batches(hbs) if hbs
+               else _empty_host_batch(child.schema))
+        if key is not None:
+            ctx.cache[key] = out
         return out
 
     # For shuffled joins the oracle joins per partition; for broadcast the
     # build side is global. Simplest correct oracle: join THIS partition's
     # probe rows against the appropriate build rows.
+    lchild, rchild = op.children
     if isinstance(op, BroadcastNestedLoopJoinExec):
-        left_rows = []
-        for hb in op.children[0].execute_host(ctx, partition):
-            left_rows.extend(hb.to_pylist())
-        right_rows = _collect(op.children[1])
+        lb = _collect(lchild, [partition])
+        rb = _collect(rchild, range(rchild.num_partitions(ctx)), "build")
         lkeys = rkeys = None
     elif isinstance(op, BroadcastHashJoinExec):
         if op.join_type != "right":
-            left_rows = []
-            for hb in op.children[0].execute_host(ctx, partition):
-                left_rows.extend(hb.to_pylist())
-            right_rows = _collect(op.children[1])
+            lb = _collect(lchild, [partition])
+            rb = _collect(rchild, range(rchild.num_partitions(ctx)),
+                          "build")
         else:
-            left_rows = _collect(op.children[0])
-            right_rows = []
-            for hb in op.children[1].execute_host(ctx, partition):
-                right_rows.extend(hb.to_pylist())
+            lb = _collect(lchild, range(lchild.num_partitions(ctx)),
+                          "build")
+            rb = _collect(rchild, [partition])
         lkeys, rkeys = op.left_keys, op.right_keys
     else:
-        left_rows = []
-        for hb in op.children[0].execute_host(ctx, partition):
-            left_rows.extend(hb.to_pylist())
-        right_rows = []
-        for hb in op.children[1].execute_host(ctx, partition):
-            right_rows.extend(hb.to_pylist())
+        lb = _collect(lchild, [partition])
+        rb = _collect(rchild, [partition])
         lkeys, rkeys = op.left_keys, op.right_keys
 
-    lschema = op.children[0].schema
-    rschema = op.children[1].schema
+    nl, nr = lb.num_rows, rb.num_rows
+    lschema, rschema = lchild.schema, rchild.schema
     jt = op.join_type
     cond = op.condition
 
-    def key_of(row, keys):
-        if keys is None:
-            return ()
-        vals = []
-        for k in keys:
-            v = row[k.ordinal]
-            if isinstance(v, float):
-                if np.isnan(v):
-                    v = "NaN"
-                elif v == 0.0:
-                    v = 0.0
-            vals.append(v)
-        return tuple(vals)
-
-    def keys_ok(row, keys):
-        return keys is None or all(row[k.ordinal] is not None for k in keys)
-
-    def cond_ok(lrow, rrow):
+    def eval_cond(li_p, ri_p):
         if cond is None:
-            return True
-        combined = lrow + rrow
-        hb = _rows_to_hb([combined], tuple(lschema) + tuple(rschema))
+            return np.ones(len(li_p), np.bool_)
+        if not len(li_p):
+            return np.zeros(0, np.bool_)
+        hb = HostBatch(
+            tuple(n for n, _ in tuple(lschema) + tuple(rschema)),
+            [c.take(li_p) for c in lb.columns]
+            + [c.take(ri_p) for c in rb.columns])
         c = as_host_column(cond.eval_host(hb), hb)
-        return bool(c.validity[0]) and bool(c.data[0])
+        return np.asarray(c.data, np.bool_) & np.asarray(c.validity,
+                                                         np.bool_)
 
-    # Equi-join: index build-side rows by canonicalized key so each
-    # probe row visits only its key group (ascending ri, preserving the
-    # nested loop's emission order exactly).
-    index = None
-    if not nested_loop:
-        index = {}
-        for ri, rrow in enumerate(right_rows):
-            if keys_ok(rrow, rkeys):
-                index.setdefault(key_of(rrow, rkeys), []).append(ri)
-
-    out = []
-    matched_right = [False] * len(right_rows)
-    for lrow in left_rows:
-        matches = []
-        if nested_loop:
-            for ri, rrow in enumerate(right_rows):
-                if cond_ok(lrow, rrow):
-                    matches.append(ri)
-        elif keys_ok(lrow, lkeys):
-            for ri in index.get(key_of(lrow, lkeys), ()):
-                if cond_ok(lrow, right_rows[ri]):
-                    matches.append(ri)
-        if jt in ("inner", "cross"):
-            for ri in matches:
-                out.append(lrow + right_rows[ri])
-        elif jt == "semi":
-            if matches:
-                out.append(lrow)
-        elif jt == "anti":
-            if not matches:
-                out.append(lrow)
-        elif jt in ("left", "full"):
-            if matches:
-                for ri in matches:
-                    out.append(lrow + right_rows[ri])
+    if nested_loop:
+        li_parts, ri_parts = [], []
+        step = max(1, (1 << 20) // max(1, nr))
+        ridx = np.arange(nr, dtype=np.int64)
+        for blo in range(0, nl, step):
+            bhi = min(nl, blo + step)
+            li_p = np.repeat(np.arange(blo, bhi, dtype=np.int64), nr)
+            ri_p = np.tile(ridx, bhi - blo)
+            ok = eval_cond(li_p, ri_p)
+            li_parts.append(li_p[ok])
+            ri_parts.append(ri_p[ok])
+        li_f = (np.concatenate(li_parts) if li_parts
+                else np.zeros(0, np.int64))
+        ri_f = (np.concatenate(ri_parts) if ri_parts
+                else np.zeros(0, np.int64))
+    else:
+        lval = np.ones(nl, np.bool_)
+        rval = np.ones(nr, np.bool_)
+        cl_parts, cr_parts = [], []
+        for lk, rk in zip(lkeys, rkeys):
+            a, b = lb.columns[lk.ordinal], rb.columns[rk.ordinal]
+            ca, cb = encode_key_pair(a, b)
+            cl_parts.append(ca)
+            cr_parts.append(cb)
+            lval &= np.asarray(a.validity, np.bool_)
+            rval &= np.asarray(b.validity, np.bool_)
+        if len(cl_parts) == 1:
+            cl, cr = cl_parts[0], cr_parts[0]
+        else:
+            allc = np.ascontiguousarray(np.concatenate(
+                [np.stack(cl_parts, 1), np.stack(cr_parts, 1)]))
+            v = allc.view(np.dtype((np.void, allc.shape[1] * 8))).ravel()
+            _, inv = np.unique(v, return_inverse=True)
+            inv = inv.astype(np.int64)
+            cl, cr = inv[:nl], inv[nl:]
+        # The build-side sort order and its equal-run boundaries are
+        # invariant across probe partitions: every key (re)encoding is
+        # order-preserving and equality-exact over the same build rows,
+        # so per-partition codes permute and segment identically. Cache
+        # them per (join, build batch) — a broadcast build (one shared
+        # batch) then sorts ONCE per query instead of once per probe
+        # partition; only the d-sized unique-code gather is per-call.
+        skey = f"hjoin-order:{id(op):x}"
+        cached = ctx.cache.get(skey)
+        if cached is not None and cached[0] is rb:
+            rs_order, rstart, rend = cached[1], cached[2], cached[3]
+        else:
+            rsel = np.flatnonzero(rval)
+            rs_order = rsel[stable_code_argsort(cr[rsel])]
+            cr_sorted = cr[rs_order]
+            if len(cr_sorted):
+                rstart = np.flatnonzero(np.concatenate(
+                    [np.ones(1, np.bool_),
+                     cr_sorted[1:] != cr_sorted[:-1]]))
+                rend = np.concatenate(
+                    [rstart[1:], np.array([len(cr_sorted)], np.int64)])
             else:
-                out.append(lrow + (None,) * len(rschema))
-        elif jt == "right":
-            for ri in matches:
-                out.append(lrow + right_rows[ri])
-        for ri in matches:
-            matched_right[ri] = True
+                rstart = rend = np.zeros(0, np.int64)
+            ctx.cache[skey] = (rb, rs_order, rstart, rend)
+        # One binary search per probe row into the UNIQUE build codes,
+        # not two over the full build: a probe's [lo, hi) run bounds
+        # come from the run-length table of the sorted codes.
+        if len(rs_order):
+            uniq = cr[rs_order[rstart]]
+            base = int(uniq[0])
+            spread = int(uniq[-1]) - base + 1
+            if spread <= max(1 << 20, 8 * len(uniq)):
+                # Dense build codes (string ranks always are; int keys
+                # usually): a direct [lo, hi) lookup table turns the
+                # per-probe-row binary search into one O(1) gather.
+                lut_lo = np.zeros(spread, np.int64)
+                lut_hi = np.zeros(spread, np.int64)
+                lut_lo[uniq - base] = rstart
+                lut_hi[uniq - base] = rend
+                idx = cl - base
+                inb = (idx >= 0) & (idx < spread) & lval
+                idx = np.where(inb, idx, 0)
+                plo = np.where(inb, lut_lo[idx], 0)
+                phi = np.where(inb, lut_hi[idx], 0)
+            else:
+                pos = np.minimum(np.searchsorted(uniq, cl, "left"),
+                                 len(uniq) - 1)
+                hit = (uniq[pos] == cl) & lval
+                plo = np.where(hit, rstart[pos], 0)
+                phi = np.where(hit, rend[pos], 0)
+        else:
+            plo = phi = np.zeros(nl, np.int64)
+        if len(rstart) == len(rs_order):
+            # Every build key is unique (dimension tables): each probe
+            # row has 0 or 1 match, so pair expansion is a masked
+            # gather — no repeat/cumsum machinery.
+            mask = phi > plo
+            li_p = np.flatnonzero(mask)
+            ri_p = rs_order[plo[li_p]]
+        else:
+            cnt = (phi - plo).astype(np.int64)
+            tot = int(cnt.sum())
+            li_p = np.repeat(np.arange(nl, dtype=np.int64), cnt)
+            offs = np.arange(tot, dtype=np.int64) \
+                - np.repeat(np.cumsum(cnt) - cnt, cnt)
+            ri_p = rs_order[np.repeat(plo, cnt) + offs]
+        ok = eval_cond(li_p, ri_p)
+        li_f, ri_f = li_p[ok], ri_p[ok]
+
+    names = tuple(n for n, _ in op.schema)
+    lmatch = np.bincount(li_f, minlength=nl)
+    if jt in ("semi", "anti"):
+        keep = lmatch > 0 if jt == "semi" else lmatch == 0
+        yield HostBatch(names, [c.filter(keep) for c in lb.columns])
+        return
+    if jt in ("left", "full"):
+        unm = np.flatnonzero(lmatch == 0)
+        li_all = np.concatenate([li_f, unm])
+        ri_all = np.concatenate([ri_f, np.full(len(unm), -1, np.int64)])
+        order = np.argsort(li_all, kind="stable")
+        li_all, ri_all = li_all[order], ri_all[order]
+    else:                                    # inner / cross / right pairs
+        li_all, ri_all = li_f, ri_f
     if jt in ("right", "full"):
-        for ri, rrow in enumerate(right_rows):
-            if not matched_right[ri]:
-                out.append((None,) * len(lschema) + rrow)
-    yield _rows_to_hb(out, op.schema)
+        rmatched = np.zeros(nr, np.bool_)
+        rmatched[ri_f] = True
+        runm = np.flatnonzero(~rmatched)
+        li_all = np.concatenate([li_all,
+                                 np.full(len(runm), -1, np.int64)])
+        ri_all = np.concatenate([ri_all, runm])
+    cols = [c.take(li_all, null_on_negative=True) for c in lb.columns] \
+        + [c.take(ri_all, null_on_negative=True) for c in rb.columns]
+    yield HostBatch(names, cols)
 
 
 def _rows_to_hb(rows, schema) -> HostBatch:

@@ -217,62 +217,44 @@ class SortExec(Exec):
         hbs = list(self.children[0].execute_host(ctx, partition))
         if not hbs:
             return
-        # Concat host batches column-wise.
-        names = hbs[0].names
-        cols = []
-        for ci, c0 in enumerate(hbs[0].columns):
-            data = np.concatenate([hb.columns[ci].data for hb in hbs])
-            validity = np.concatenate([hb.columns[ci].validity for hb in hbs])
-            cols.append(HostColumn(c0.dtype, data, validity))
-        merged = HostBatch(names, cols)
-        yield sort_host_batch(merged, self.orders)
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        yield sort_host_batch(concat_host_batches(hbs), self.orders)
+
+
+def host_sort_indices(hb: HostBatch,
+                      orders: Sequence[SortOrder]) -> np.ndarray:
+    """Stable row permutation sorting ``hb`` under Spark semantics
+    (float total order via sign-flipped raw bits — every NaN canonical
+    and greatest, -0.0 < 0.0 — plus per-key null ordering).
+
+    Vectorized: each order key becomes two np.lexsort planes — the
+    null-rank plane (always ascending: null placement never flips with
+    the key direction, matching the row-oracle this replaced) and the
+    type-aware int64 code from encode_sort_key, bit-inverted for descending
+    (~x reverses int64 order with no INT64_MIN overflow). np.lexsort is
+    stable, so ties keep input order exactly like the python sort."""
+    from spark_rapids_tpu.columnar.host import encode_sort_key
+    planes = []
+    for o in orders:
+        col = as_host_column(o.child.eval_host(hb), hb)
+        valid = np.asarray(col.validity, np.bool_)
+        null_rank = (valid if o.nulls_first else ~valid).astype(np.int8)
+        code = encode_sort_key(col)
+        if not o.ascending:
+            code = np.where(valid, ~code, np.int64(0))
+        planes.append((null_rank, code))
+    # np.lexsort keys run last-to-first, so emit least-significant first.
+    lex = []
+    for null_rank, code in reversed(planes):
+        lex.append(code)
+        lex.append(null_rank)
+    return np.lexsort(lex)
 
 
 def sort_host_batch(hb: HostBatch, orders: Sequence[SortOrder]) -> HostBatch:
-    """Host oracle sort with Spark semantics (NaN greatest, null ordering)."""
-    n = hb.num_rows
-    keys = []
-    for o in orders:
-        col = as_host_column(o.child.eval_host(hb), hb)
-        keys.append((col, o))
-
-    def sort_key(i: int):
-        parts = []
-        for col, o in keys:
-            valid = bool(col.validity[i])
-            null_rank = 0 if (not valid) == o.nulls_first else 1
-            if not valid:
-                part = (null_rank, 0)
-            else:
-                v = col.data[i]
-                if col.dtype.is_string:
-                    v = bytes(v)
-                elif col.dtype.is_floating:
-                    # Java Double.compare total order (Spark sort
-                    # semantics): -0.0 < 0.0, every NaN greatest — via
-                    # the sign-flipped raw-bits key, matching the device
-                    # radix sort's float-domain word transform. All NaN
-                    # bit patterns (incl. sign-bit NaN) canonicalize.
-                    f = float(v)
-                    if np.isnan(f):
-                        v = 0x7FF8000000000000
-                    else:
-                        bits = struct.unpack(
-                            "<q", struct.pack("<d", f))[0]
-                        v = bits if bits >= 0 \
-                            else bits ^ 0x7FFFFFFFFFFFFFFF
-                elif col.dtype.is_boolean:
-                    v = bool(v)
-                else:
-                    v = int(v)
-                part = (null_rank, _Rev(v) if not o.ascending else v)
-            parts.append(part)
-        return tuple(parts)
-
-    order = sorted(range(n), key=sort_key)
-    cols = [HostColumn(c.dtype, c.data[order], c.validity[order])
-            for c in hb.columns]
-    return HostBatch(hb.names, cols)
+    """Host sort with Spark semantics (NaN greatest, null ordering)."""
+    order = host_sort_indices(hb, orders)
+    return hb.take(order)
 
 
 @functools.total_ordering

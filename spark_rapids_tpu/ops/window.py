@@ -32,7 +32,8 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as dt
 from spark_rapids_tpu.columnar.batch import DeviceBatch, DeviceColumn
-from spark_rapids_tpu.columnar.host import HostBatch, HostColumn
+from spark_rapids_tpu.columnar.host import HostBatch, HostColumn, \
+    all_valid as host_all_valid
 from spark_rapids_tpu.exprs.base import Expression, as_device_column, \
     as_host_column
 from spark_rapids_tpu.ops.base import Exec, ExecContext, Schema, timed
@@ -565,27 +566,235 @@ class WindowExec(Exec):
             self.children[0].execute_device(ctx, partition),
             self.children[0].schema, orders, self._window_fn(ctx))
 
-    # -- host oracle ---------------------------------------------------------
+    # -- host engine ---------------------------------------------------------
     def execute_host(self, ctx, partition):
         hbs = list(self.children[0].execute_host(ctx, partition))
         if not hbs:
             return
-        names = hbs[0].names
-        cols = []
-        for ci, c0 in enumerate(hbs[0].columns):
-            data = np.concatenate([hb.columns[ci].data for hb in hbs])
-            val = np.concatenate([hb.columns[ci].validity for hb in hbs])
-            cols.append(HostColumn(c0.dtype, data, val))
-        hb = HostBatch(names, cols)
+        from spark_rapids_tpu.columnar.host import concat_host_batches
+        hb = concat_host_batches(hbs)
         yield _host_window(hb, self.exprs, self.schema)
 
 
-def _host_window(hb: HostBatch, exprs, schema) -> HostBatch:
-    """Python oracle: sort rows per spec, evaluate per partition."""
+def _host_window_vectorized(hb: HostBatch, wx) -> "HostColumn":
+    """One window expression evaluated with the lexsort/segment-boundary
+    machinery of the vectorized host group-by: one stable lexsort over
+    (partition codes, order-key codes), partition/peer boundary flags,
+    then ranks as positions-in-segment, Lead/Lag as clamped shifted
+    gathers, and frame aggregates as prefix-sum differences (the same
+    cumsum-minus-segment-start shape the device kernels use). Results
+    come back through the inverse permutation so output rows keep input
+    order. Returns None for shapes the python oracle below still owns
+    (min/max over bounded frames, string agg inputs, descending or
+    null-bearing range frames)."""
+    from spark_rapids_tpu.columnar.host import (encode_key,
+                                                encode_sort_key)
     n = hb.num_rows
-    rows = hb.to_pylist()
+    fn = wx.fn
+    if n == 0:
+        return None
+    pcols = [as_host_column(e.eval_host(hb), hb)
+             for e in wx.spec.partition_by]
+    ocols = [(as_host_column(o.child.eval_host(hb), hb), o)
+             for o in wx.spec.order_by]
+    ccol = None
+    if isinstance(fn, (Lead, Lag, WindowAgg)) and \
+            getattr(fn, "child", None) is not None:
+        ccol = as_host_column(fn.child.eval_host(hb), hb)
+
+    part_planes = []
+    for c in pcols:
+        part_planes.append((encode_key(c),
+                            np.asarray(c.validity, np.int8)))
+    okey_planes = []
+    for c, o in ocols:
+        valid = np.asarray(c.validity, np.bool_)
+        null_rank = (valid if o.nulls_first else ~valid).astype(np.int8)
+        code = encode_sort_key(c)
+        if not o.ascending:
+            code = np.where(valid, ~code, np.int64(0))
+        okey_planes.append((null_rank, code))
+
+    # Most-significant first; np.lexsort takes least-significant first.
+    sig = []
+    for code, val in part_planes:
+        sig.append(code)
+        sig.append(val)
+    for null_rank, code in okey_planes:
+        sig.append(null_rank)
+        sig.append(code)
+    if sig:
+        order_idx = np.lexsort(tuple(reversed(sig)))
+    else:
+        order_idx = np.arange(n, dtype=np.int64)
+
+    pos = np.arange(n, dtype=np.int64)
+    seg_flags = np.zeros(n, np.bool_)
+    seg_flags[0] = True
+    for code, val in part_planes:
+        sc, sv = code[order_idx], val[order_idx]
+        seg_flags[1:] |= (sc[1:] != sc[:-1]) | (sv[1:] != sv[:-1])
+    starts = np.flatnonzero(seg_flags).astype(np.int64)
+    seg_len = np.diff(np.append(starts, n))
+    seg_start = np.repeat(starts, seg_len)
+    seg_end = np.repeat(starts + seg_len - 1, seg_len)
+    r_local = pos - seg_start
+
+    change = seg_flags.copy()
+    for null_rank, code in okey_planes:
+        snr, sc = null_rank[order_idx], code[order_idx]
+        change[1:] |= (snr[1:] != snr[:-1]) | (sc[1:] != sc[:-1])
+    rb = np.flatnonzero(change).astype(np.int64)
+    run_len = np.diff(np.append(rb, n))
+    peer_start = np.repeat(rb, run_len)
+    peer_end = np.repeat(rb + run_len - 1, run_len)
+
+    inv = np.empty(n, np.int64)
+    inv[order_idx] = pos
+
+    def out_numeric(t, data, validity):
+        return HostColumn(t, np.where(validity, data, 0)
+                          .astype(t.np_dtype),
+                          np.asarray(validity, np.bool_)).take(inv)
+
+    t = fn.result_type()
+    if isinstance(fn, RowNumber):
+        return out_numeric(t, r_local + 1, host_all_valid(n))
+    if isinstance(fn, DenseRank):
+        d = np.cumsum(change)
+        dense = d - np.repeat(d[starts], seg_len) + 1
+        return out_numeric(t, dense, host_all_valid(n))
+    if isinstance(fn, Rank):
+        return out_numeric(t, peer_start - seg_start + 1,
+                           host_all_valid(n))
+    if isinstance(fn, (Lead, Lag)):
+        off = fn.offset if isinstance(fn, Lead) else -fn.offset
+        tgt = pos + off
+        inrange = (tgt >= seg_start) & (tgt <= seg_end)
+        idx = np.where(inrange, order_idx[np.clip(tgt, 0, n - 1)],
+                       np.int64(-1))
+        return ccol.take(idx, null_on_negative=True).take(inv)
+    if not isinstance(fn, WindowAgg):
+        return None
+
+    frame = fn.frame
+    kind = fn.kind
+    if ccol is not None and ccol.dtype.is_string and kind != "count":
+        return None
+    # Frame bounds as global [lo, hi] row ranges per row.
+    if frame.running_with_peers:
+        lo, hi = seg_start, peer_end
+    elif frame.preceding is UNBOUNDED and frame.following is UNBOUNDED:
+        lo, hi = seg_start, seg_end
+    elif frame.range_interval:
+        if not ocols:
+            return None
+        oc, oo = ocols[0]
+        if (not oo.ascending or oc.dtype.is_string
+                or not np.asarray(oc.validity, np.bool_).all()):
+            return None
+        ov = np.asarray(oc.data, np.float64)[order_idx]
+        cur = ov                                  # current row's value
+        lo = seg_start.copy()
+        hi = seg_end.copy()
+        for s0, sl in zip(starts.tolist(), seg_len.tolist()):
+            s1 = s0 + sl
+            vals_seg = ov[s0:s1]
+            if frame.preceding is not UNBOUNDED:
+                lo[s0:s1] = s0 + np.searchsorted(
+                    vals_seg, cur[s0:s1] - frame.preceding, "left")
+            if frame.following is not UNBOUNDED:
+                hi[s0:s1] = s0 + np.searchsorted(
+                    vals_seg, cur[s0:s1] + frame.following, "right") - 1
+    else:
+        lo = seg_start if frame.preceding is UNBOUNDED else \
+            np.maximum(seg_start, pos - frame.preceding)
+        hi = seg_end if frame.following is UNBOUNDED else \
+            np.minimum(seg_end, pos + frame.following)
+
+    empty = hi < lo
+    loc = np.clip(lo, 0, n)
+    hic = np.clip(hi + 1, 0, n)
+
+    def prefix(x):
+        return np.concatenate([np.zeros(1, x.dtype), np.cumsum(x)])
+
+    if ccol is not None:
+        cvalid = np.asarray(ccol.validity, np.bool_)[order_idx]
+    else:
+        cvalid = host_all_valid(n)
+    Pc = prefix(cvalid.astype(np.int64))
+    cnt = np.where(empty, 0, Pc[hic] - Pc[loc])
+
+    if kind == "count":
+        total = np.where(empty, 0, hi - lo + 1)
+        data = cnt if ccol is not None else total
+        return out_numeric(t, data, host_all_valid(n))
+
+    if kind in ("sum", "avg"):
+        x = np.asarray(ccol.data)[order_idx]
+        if t.is_floating or kind == "avg":
+            xf = np.where(cvalid, x.astype(np.float64), 0.0)
+            if np.isnan(xf).any():
+                # A prefix-sum difference leaks NaN into every frame
+                # after the NaN (cumsum is global); the oracle sums
+                # only the frame's own rows.
+                return None
+            P = prefix(xf)
+        else:
+            with np.errstate(over="ignore"):
+                P = prefix(np.where(cvalid, x.astype(np.int64),
+                                    np.int64(0)))
+        s = np.where(empty, 0, P[hic] - P[loc])
+        ok = cnt > 0
+        if kind == "avg":
+            data = np.where(ok, s / np.where(ok, cnt, 1), 0.0)
+        else:
+            data = np.where(ok, s, 0)
+        return out_numeric(t, data, ok)
+
+    # min/max: only the whole-segment frame vectorizes (a prefix trick
+    # does not exist for range min); bounded frames stay on the oracle.
+    if not (np.array_equal(lo, seg_start) and np.array_equal(hi, seg_end)):
+        return None
+    x = np.asarray(ccol.data)[order_idx]
+    ok = np.add.reduceat(cvalid.astype(np.int64), starts) > 0
+    if ccol.dtype.is_floating:
+        f = x.astype(np.float64)
+        nanm = cvalid & np.isnan(f)
+        nonnan = cvalid & ~np.isnan(f)
+        if kind == "max":
+            m = np.maximum.reduceat(np.where(nonnan, f, -np.inf), starts)
+            hasnan = np.add.reduceat(nanm.astype(np.int64), starts) > 0
+            data_g = np.where(hasnan, np.nan, m)
+        else:
+            m = np.minimum.reduceat(np.where(nonnan, f, np.inf), starts)
+            nncnt = np.add.reduceat(nonnan.astype(np.int64), starts)
+            data_g = np.where(nncnt > 0, m, np.nan)
+        data_g = np.where(ok, data_g, 0.0)
+    else:
+        xi64 = x.astype(np.int64)
+        if kind == "max":
+            data_g = np.maximum.reduceat(
+                np.where(cvalid, xi64, np.iinfo(np.int64).min), starts)
+        else:
+            data_g = np.minimum.reduceat(
+                np.where(cvalid, xi64, np.iinfo(np.int64).max), starts)
+        data_g = np.where(ok, data_g, 0)
+    data = np.repeat(data_g, seg_len)
+    validity = np.repeat(ok, seg_len)
+    return out_numeric(t, data, validity)
+
+
+def _host_window(hb: HostBatch, exprs, schema) -> HostBatch:
+    """Host window: vectorized per expression, python oracle fallback."""
+    n = hb.num_rows
     out_cols = {i: [None] * n for i in range(len(exprs))}
     for xi, wx in enumerate(exprs):
+        fast = _host_window_vectorized(hb, wx)
+        if fast is not None:
+            out_cols[xi] = fast
+            continue
         pcols = [as_host_column(e.eval_host(hb), hb).to_list()
                  for e in wx.spec.partition_by]
         ocols = [(as_host_column(o.child.eval_host(hb), hb).to_list(), o)
@@ -639,8 +848,11 @@ def _host_window(hb: HostBatch, exprs, schema) -> HostBatch:
                 wx.fn, idxs, peers, ccol, out_cols[xi], ovals)
     cols = list(hb.columns)
     for xi, wx in enumerate(exprs):
-        t = wx.fn.result_type()
-        cols.append(HostColumn.from_values(t, out_cols[xi]))
+        if isinstance(out_cols[xi], HostColumn):
+            cols.append(out_cols[xi])
+        else:
+            t = wx.fn.result_type()
+            cols.append(HostColumn.from_values(t, out_cols[xi]))
     return HostBatch(tuple(n_ for n_, _ in schema), cols)
 
 

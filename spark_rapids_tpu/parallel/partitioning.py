@@ -228,15 +228,35 @@ def split_host_batch(hb: HostBatch, pids: np.ndarray,
     per partition (O(n) x num_partitions). The host engine is a
     first-class placement target now (plan/cost.py), so its shuffle
     split runs the same move-all-rows-once shape as the device split."""
-    order = np.argsort(pids, kind="stable")
+    from spark_rapids_tpu.columnar.host import stable_code_argsort
+    order = stable_code_argsort(np.asarray(pids, np.int64))
     counts = np.bincount(pids[order], minlength=num_partitions)
     offsets = np.concatenate([[0], np.cumsum(counts)])
-    gathered = [(c.dtype, c.data[order], c.validity[order])
-                for c in hb.columns]
+    # take() keeps dense string layouts dense — slicing .data here would
+    # materialize object arrays and force every downstream string kernel
+    # back through a strings_to_matrix re-encode.
+    gathered = [c.take(order) for c in hb.columns]
     out = []
     for p in range(num_partitions):
-        lo, hi = offsets[p], offsets[p + 1]
-        cols = [HostColumn(dtype, data[lo:hi], validity[lo:hi])
-                for dtype, data, validity in gathered]
+        lo, hi = int(offsets[p]), int(offsets[p + 1])
+        cols = []
+        for g in gathered:
+            if g.dtype.is_string and g._data is None:
+                c = HostColumn(
+                    g.dtype, None, g.validity[lo:hi],
+                    str_matrix=g.str_matrix[lo:hi],
+                    str_lengths=g.str_lengths[lo:hi])
+            else:
+                c = HostColumn(g.dtype, g.data[lo:hi],
+                               g.validity[lo:hi])
+            if g._key_codes is not None:
+                # Key-code propagation through the shuffle: the reduce
+                # side merges per-map-shard code dictionaries instead of
+                # re-ranking every received row (columnar/host.py).
+                c._key_codes = g._key_codes[lo:hi]
+                c._key_uniq = g._key_uniq
+            elif g.dtype.is_string:
+                c._key_src = (g, slice(lo, hi), None)
+            cols.append(c)
         out.append(HostBatch(hb.names, cols))
     return out

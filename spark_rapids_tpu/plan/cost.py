@@ -93,12 +93,35 @@ def _clamped(value: float, default: float) -> float:
     return min(max(value, default / 4.0), default * 4.0)
 
 
+_CPU_ONLY_BACKEND: Optional[bool] = None
+
+
+def _cpu_only_backend() -> bool:
+    """True when the "device" engine itself runs on host CPU (tests,
+    local dev: JAX_PLATFORMS=cpu). There is no dispatch tunnel between
+    the planner and a CPU backend, so the per-sync floor the model is
+    calibrated for physically does not exist — charging it would
+    host-place nearly every small plan."""
+    global _CPU_ONLY_BACKEND
+    if _CPU_ONLY_BACKEND is None:
+        try:
+            import jax
+            _CPU_ONLY_BACKEND = jax.default_backend() == "cpu"
+        except Exception:
+            _CPU_ONLY_BACKEND = False
+    return _CPU_ONLY_BACKEND
+
+
 def effective_sync_floor_ms(conf: "C.TpuConf") -> float:
     """The sync floor the estimator charges: an explicit conf key wins;
-    else the calibrated observation (clamped); else the default."""
+    else zero on a CPU-only backend (no tunnel to sync through); else
+    the calibrated observation (clamped); else the default."""
     configured = float(conf.get(C.COST_SYNC_FLOOR_MS))
-    if conf.raw.get(C.COST_SYNC_FLOOR_MS.key) is not None or \
-            not calibration_enabled(conf):
+    if conf.raw.get(C.COST_SYNC_FLOOR_MS.key) is not None:
+        return configured
+    if _cpu_only_backend() and not conf.get(C.COST_ASSUME_TUNNEL):
+        return 0.0
+    if not calibration_enabled(conf):
         return configured
     with _CAL_LOCK:
         cal = _CAL["sync_floor_ms"]

@@ -861,6 +861,25 @@ class LogicalPlan:
         return type(self).__name__
 
 
+def _cached_schema(fn):
+    """Memoize a node's schema keyed on the IDENTITY of its children
+    tuple. Plan rewrites (pruning._with_children, planner.merge_windows)
+    never mutate a node in place — they shallow-copy and install a NEW
+    children tuple — so tuple identity is a sound validity token, and
+    holding the tuple in the memo keeps it alive (no id-reuse hazard).
+    Without this, ``schema`` re-resolves every projection recursively on
+    each access: a rollup plan like q67 pays ~30k resolve() calls per
+    collect just answering type questions the tree already answered."""
+    def get(self):
+        memo = self.__dict__.get("_schema_memo")
+        if memo is not None and memo[0] is self.children:
+            return memo[1]
+        s = fn(self)
+        self.__dict__["_schema_memo"] = (self.children, s)
+        return s
+    return property(get)
+
+
 @dataclasses.dataclass
 class InMemoryScan(LogicalPlan):
     source_schema: Schema
@@ -917,7 +936,7 @@ class LogicalFilter(_Unary):
         super().__init__(child)
         self.condition = condition
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return self.child.schema
 
@@ -927,7 +946,7 @@ class LogicalProject(_Unary):
         super().__init__(child)
         self.projections = list(projections)
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         out = []
         for name, c in self.projections:
@@ -948,7 +967,7 @@ class LogicalAggregate(_Unary):
         assert grouping in (None, "rollup", "cube")
         self.grouping = grouping
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         from spark_rapids_tpu.plan.planner import resolve_agg
         out = []
@@ -1000,7 +1019,7 @@ class LogicalWindow(_Unary):
         raise ResolutionError(
             f"unsupported window function {node[0]!r}")
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return tuple(self.child.schema) + tuple(
             (n, self.result_type(c)) for n, c in self.exprs)
@@ -1022,7 +1041,7 @@ class LogicalGenerate(_Unary):
         t0 = resolve(self.elements[0], self.child.schema).data_type()
         return t0
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         out = list(self.child.schema)
         if self.position:
@@ -1036,7 +1055,7 @@ class LogicalSort(_Unary):
         super().__init__(child)
         self.orders = list(orders)
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return self.child.schema
 
@@ -1046,7 +1065,7 @@ class LogicalLimit(_Unary):
         super().__init__(child)
         self.n = n
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return self.child.schema
 
@@ -1058,7 +1077,7 @@ class LogicalRepartition(_Unary):
         self.num_partitions = num_partitions
         self.keys = list(keys) if keys else None
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return self.child.schema
 
@@ -1067,7 +1086,7 @@ class LogicalUnion(LogicalPlan):
     def __init__(self, *children: LogicalPlan):
         self.children = tuple(children)
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         return self.children[0].schema
 
@@ -1127,7 +1146,7 @@ class LogicalAggInPandas(_Unary):
         self.key_names = list(key_names)
         self.aggs = list(aggs)
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         key_types = dict(self.child.schema)
         return tuple([(k, key_types[k]) for k in self.key_names]
@@ -1147,7 +1166,7 @@ class LogicalJoin(LogicalPlan):
         self.condition = condition
         self.strategy = strategy    # auto | broadcast | shuffle
 
-    @property
+    @_cached_schema
     def schema(self) -> Schema:
         if self.join_type in ("semi", "anti"):
             return self.children[0].schema

@@ -27,16 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
-# Cost-based placement stands down for the suite: the tests emulate the
-# TPU on local CPU devices, where the ~80ms tunnel sync floor the model
-# is calibrated for does not exist — left on, the production constants
-# would (correctly, for real hardware) host-place nearly every
-# mini-scale fixture and the suite would stop exercising the device
-# engine it exists to cover. Placement behavior itself is covered by
-# tests/test_cost.py, whose sessions opt in via the conf key (conf
-# beats env in cost_enabled). An explicit SRT_COST in the environment
-# (e.g. the CI no-cost-placement matrix entry) still wins.
-os.environ.setdefault("SRT_COST", "0")
+# Cost-based placement no longer needs an env kill-switch here: the
+# estimator detects the CPU-only backend the suite runs on and zeroes
+# the tunnel sync floor itself (plan/cost.py effective_sync_floor_ms),
+# so mini-scale fixtures stay device-placed without production
+# constants being misapplied. Placement behavior is covered by
+# tests/test_cost.py via explicit conf keys; an SRT_COST in the
+# environment (the CI no-cost-placement matrix entry) still wins.
 
 # Acceptance hook: SRT_STAGE_FUSION=0 flips the stage-fusion default off
 # for a whole test run, verifying every suite still passes with the

@@ -41,9 +41,11 @@ def _scan_agg(session, pq_dir):
 def _session(**conf):
     s = TpuSession()
     s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
-    # Opt back in under the suite-wide SRT_COST=0 (tests/conftest.py):
-    # the conf key beats the env, and explicit kwargs below beat this.
     s.set("spark.rapids.sql.cost.enabled", True)
+    # The suite runs on a CPU-only backend, where the estimator zeroes
+    # the sync floor (no tunnel). These scenarios exercise placement as
+    # it behaves on real hardware, so opt into the tunnel constants.
+    s.set("spark.rapids.sql.cost.assumeTunnel", True)
     for k, v in conf.items():
         s.set(k, v)
     return s
@@ -288,7 +290,11 @@ class TestCalibration:
 
     def _conf(self, **raw):
         from spark_rapids_tpu.config import TpuConf
-        return TpuConf(dict(raw))
+        # Calibration semantics are backend-independent; bypass the
+        # CPU-only sync-floor zeroing so the constants stay observable.
+        d = {"spark.rapids.sql.cost.assumeTunnel": True}
+        d.update(raw)
+        return TpuConf(d)
 
     def test_observation_moves_effective_values(self):
         from spark_rapids_tpu import config as C
