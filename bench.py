@@ -681,6 +681,15 @@ def _distributed_probe(tpch_dir: str) -> dict:
     w3 = res.get("workers_3", {}).get("seconds")
     if w1 and w3:
         res["speedup_3v1"] = round(w1 / w3, 3)
+        # The scaling expectation is conditional on cores: three
+        # co-located workers can only overlap stage compute with >= 3
+        # host CPUs. There, speedup_3v1 > 1 is asserted (speedup_ok);
+        # on smaller hosts (1-core CI) the honest reading is
+        # distribution OVERHEAD, so speedup_ok stays null and parity
+        # with workers_1 is the best possible result.
+        cpus = res.get("host_cpus") or 1
+        res["speedup_ok"] = \
+            bool(res["speedup_3v1"] > 1.0) if cpus >= 3 else None
     # Coordinator failover cost: replay the 3-worker round's journal
     # into a fresh coordinator, exactly what a SIGKILL + restart pays
     # before it starts listening (parallel/cluster/journal.py).
@@ -700,6 +709,115 @@ def _distributed_probe(tpch_dir: str) -> dict:
         k: bc1.get(k, 0) - bc0.get(k, 0)
         for k in ("broadcastCacheHits", "broadcastCacheMisses",
                   "broadcastCachePublishes")}
+    return res
+
+
+def _autoscale_probe(tpch_dir: str) -> dict:
+    """Self-healing fleet (ISSUE 20): shuffle-forced q3 bursts against
+    a supervised, SLO-autoscaled pool. Records the worker-count
+    timeline (sampled while the burst runs and through the idle
+    scale-down window), one healed SIGKILL, and the supervisor /
+    autoscaler action counters — the bench-side mirror of the
+    tests/test_autoscale.py soak."""
+    import subprocess  # noqa: F401  (worker spawns via the supervisor)
+
+    from spark_rapids_tpu import config as _C
+    from spark_rapids_tpu import faults as _faults
+    from spark_rapids_tpu.benchmarks import tpch
+    from spark_rapids_tpu.parallel import cluster as CL
+    from spark_rapids_tpu.parallel.cluster.autoscaler import Autoscaler
+    from spark_rapids_tpu.parallel.cluster.supervisor import (
+        RUNNING, Supervisor)
+
+    sc = _session()
+    sc.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    sc.set("spark.rapids.sql.cluster.enabled", True)
+    sc.set("spark.rapids.sql.cluster.heartbeatTimeoutMs", 1500)
+    co = CL.get_coordinator(sc.conf)
+    addr = f"{co.addr[0]}:{co.addr[1]}"
+    aconf = _C.TpuConf({
+        "spark.rapids.sql.cluster.autoscale.minWorkers": 1,
+        "spark.rapids.sql.cluster.autoscale.maxWorkers": 2,
+        "spark.rapids.sql.cluster.autoscale.targetQueuedMs": 50,
+        "spark.rapids.sql.cluster.autoscale.scaleDownIdleS": 2,
+        "spark.rapids.sql.cluster.autoscale.cooldownMs": 500,
+        "spark.rapids.sql.cluster.supervisor.pollMs": 100,
+        "spark.rapids.sql.cluster.supervisor.restartBackoffBaseMs":
+            100})
+    sup = Supervisor(addr, conf=aconf, prefix="bs", heartbeat_ms=500)
+    scaler = Autoscaler(sup, conf=aconf)
+    sup.add_worker()
+    c0 = dict(_faults.counters())
+    timeline: list = []
+    stop_sampler = threading.Event()
+
+    def sample():
+        t0 = time.perf_counter()
+        while not stop_sampler.wait(0.2):
+            timeline.append({"t_s": round(time.perf_counter() - t0, 1),
+                             "workers": sup.active_count()})
+
+    df = tpch.QUERIES["q3"](sc, tpch_dir)
+    res: dict = {}
+    sup.start()
+    scaler.start()
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    try:
+        df.collect()                      # warm the first worker's JIT
+        killed = False
+        errors = 0
+
+        def burst(n):
+            nonlocal errors
+            for _ in range(n):
+                try:
+                    df.collect()
+                except Exception:
+                    errors += 1
+
+        threads = [threading.Thread(target=burst, args=(3,))
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+        # One SIGKILL mid-burst: the supervisor heals it.
+        time.sleep(0.5)
+        with sup._lock:
+            running = [w for w in sup.workers.values()
+                       if w.state == RUNNING and w.proc.poll() is None]
+        if running:
+            running[0].proc.kill()
+            killed = True
+        for t in threads:
+            t.join(120)
+        # Quiet window: the idle clock drains the pool back down.
+        deadline = time.monotonic() + 15
+        while sup.active_count() > scaler.min_workers and \
+                time.monotonic() < deadline:
+            time.sleep(0.25)
+        c1 = _faults.counters()
+        res = {
+            "errors": errors,
+            "sigkill_injected": killed,
+            "worker_timeline": timeline[-60:],
+            "peak_workers": max((p["workers"] for p in timeline),
+                                default=1),
+            "final_workers": sup.active_count(),
+            "worker_deaths": c1.get("clusterWorkerDeaths", 0)
+            - c0.get("clusterWorkerDeaths", 0),
+            "stage_recomputes": c1.get("stageRecomputes", 0)
+            - c0.get("stageRecomputes", 0),
+            "restarts": sup.counters["restarts"],
+            "quarantines": sup.counters["quarantines"],
+            "drains": sup.counters["drains"],
+            "retirements": sup.counters["retirements"],
+            "scale_decisions": dict(scaler.decisions),
+        }
+    finally:
+        stop_sampler.set()
+        scaler.stop()
+        sup.close()
+        CL.shutdown_coordinator()
     return res
 
 
@@ -969,6 +1087,15 @@ def main():
             dist = _distributed_probe(packs["q3"][1])
         except Exception as e:  # the headline must survive a probe bug
             dist = {"error": f"{type(e).__name__}: {e}"}
+        # Self-healing fleet sub-block (ISSUE 20): supervised +
+        # autoscaled pool under a q3 burst with one healed SIGKILL.
+        if "error" not in dist and _remaining(budget) > 60 and \
+                os.environ.get("BENCH_DISTRIBUTED", "1") != "0":
+            try:
+                dist["autoscale"] = _autoscale_probe(packs["q3"][1])
+            except Exception as e:
+                dist["autoscale"] = {
+                    "error": f"{type(e).__name__}: {e}"}
         with _LOCK:
             out["distributed"] = dist
 

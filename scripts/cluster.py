@@ -14,8 +14,16 @@ launcher:
   * ``--demo`` is the self-contained smoke path: generate a small TPC-H
     dataset, start a coordinator in-process, spawn the pool, run one
     query distributed and check it bit-identical against the local run.
+  * ``--supervise`` runs the pool under the self-healing supervisor
+    (ISSUE 20): dead workers restart with exponential backoff,
+    crash-loopers are quarantined, stragglers demoted and scale-down
+    drains cleanly. Add ``--autoscale`` to let the SLO loop size the
+    pool between ``cluster.autoscale.minWorkers``/``maxWorkers``
+    instead of holding ``--workers`` fixed.
 
 Run: python scripts/cluster.py --workers 3 --coordinator 127.0.0.1:41234
+     python scripts/cluster.py --supervise --workers 3 \
+         --coordinator 127.0.0.1:41234
      python scripts/cluster.py --demo --workers 3 --query q3
 """
 
@@ -81,6 +89,48 @@ def run_pool(args):
     return rc
 
 
+def run_supervised(args):
+    """Run the pool under the self-healing supervisor (and optionally
+    the SLO autoscaler) instead of bare subprocesses."""
+    from spark_rapids_tpu import config as C
+    from spark_rapids_tpu.parallel.cluster.autoscaler import Autoscaler
+    from spark_rapids_tpu.parallel.cluster.supervisor import Supervisor
+
+    conf = C.TpuConf({})
+    sup = Supervisor(args.coordinator, conf=conf, prefix=args.prefix,
+                     heartbeat_ms=args.heartbeat_ms)
+    scaler = None
+    if args.autoscale or conf.get(C.CLUSTER_AUTOSCALE_ENABLED):
+        scaler = Autoscaler(sup, conf=conf)
+        start_n = scaler.min_workers
+    else:
+        start_n = args.workers
+    for _ in range(start_n):
+        sup.add_worker()
+
+    stop = []
+
+    def on_signal(signum, frame):
+        stop.append(signum)
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    mode = "autoscaled" if scaler else "supervised"
+    print(f"cluster.py: {start_n} {mode} worker(s) -> "
+          f"{args.coordinator}")
+    sup.start()
+    if scaler:
+        scaler.start()
+    try:
+        while not stop:
+            time.sleep(0.25)
+    finally:
+        if scaler:
+            scaler.stop()
+        sup.close()
+    return 0
+
+
 def run_demo(args):
     from spark_rapids_tpu.api.dataframe import TpuSession
     from spark_rapids_tpu.benchmarks import tpch
@@ -144,6 +194,12 @@ def main(argv=None):
                     help="worker-id prefix (ids are <prefix>0..N-1)")
     ap.add_argument("--demo", action="store_true",
                     help="self-contained: coordinator + pool + one query")
+    ap.add_argument("--supervise", action="store_true",
+                    help="run the pool under the self-healing "
+                         "supervisor (restart/quarantine/drain)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="with --supervise: SLO autoscaler sizes the "
+                         "pool (cluster.autoscale.* knobs)")
     ap.add_argument("--query", default="q3",
                     help="TPC-H query for --demo")
     ap.add_argument("--scale", type=float, default=0.01,
@@ -153,7 +209,11 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if not args.demo and not args.coordinator:
         ap.error("--coordinator is required unless --demo")
-    return run_demo(args) if args.demo else run_pool(args)
+    if args.demo:
+        return run_demo(args)
+    if args.supervise or args.autoscale:
+        return run_supervised(args)
+    return run_pool(args)
 
 
 if __name__ == "__main__":

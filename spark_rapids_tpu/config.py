@@ -1113,6 +1113,113 @@ CLUSTER_JOURNAL_FSYNC = conf(
     "budgeted), so the default buys dispatch latency instead of "
     "durability theater.").boolean(False)
 
+CLUSTER_AUTOSCALE_ENABLED = conf(
+    "spark.rapids.sql.cluster.autoscale.enabled").doc(
+    "SLO-driven autoscaling of the worker pool "
+    "(parallel/cluster/autoscaler.py): the autoscaler loop watches "
+    "admission queueing (srt_admission_queued_ms), run-queue depth and "
+    "fleet pressure (srt_pressure_score) against targetQueuedMs and "
+    "spawns or cleanly drains workers through the supervisor, within "
+    "[minWorkers, maxWorkers] and subject to cooldownMs hysteresis. "
+    "Default off: the pool size is whatever was launched and no "
+    "scaling decision is ever taken.").boolean(False)
+
+CLUSTER_AUTOSCALE_MIN_WORKERS = conf(
+    "spark.rapids.sql.cluster.autoscale.minWorkers").doc(
+    "Autoscaler floor: the pool never drains below this many "
+    "supervised workers, regardless of how idle the fleet is."
+).integer(1)
+
+CLUSTER_AUTOSCALE_MAX_WORKERS = conf(
+    "spark.rapids.sql.cluster.autoscale.maxWorkers").doc(
+    "Autoscaler ceiling: scale-up stops here. When the fleet is at "
+    "the ceiling AND pressure stays sustained, brownout admission "
+    "shedding engages (scale-up is tried FIRST — see "
+    "scheduler.pressure.brownout.*).").integer(4)
+
+CLUSTER_AUTOSCALE_TARGET_QUEUED_MS = conf(
+    "spark.rapids.sql.cluster.autoscale.targetQueuedMs").doc(
+    "Per-class admission-wait SLO the scale-up rule defends: when the "
+    "observed queued-ms signal (worst class) exceeds this target, or "
+    "the run queue is non-empty with every worker busy, the "
+    "autoscaler requests scaleUpStep more workers.").integer(500)
+
+CLUSTER_AUTOSCALE_SCALE_UP_STEP = conf(
+    "spark.rapids.sql.cluster.autoscale.scaleUpStep").doc(
+    "How many workers one scale-up decision adds (bounded by "
+    "maxWorkers). Scale-down always retires exactly one worker per "
+    "decision — draining is deliberately slower than spawning."
+).integer(1)
+
+CLUSTER_AUTOSCALE_SCALE_DOWN_IDLE_S = conf(
+    "spark.rapids.sql.cluster.autoscale.scaleDownIdleS").doc(
+    "How long the load signals must stay below target (no queueing, "
+    "spare workers idle) before one worker is drained. Drains use "
+    "CDRAIN: the coordinator stops dispatching to the worker, waits "
+    "for its in-flight stages to commit their manifests, then "
+    "retires it — scale-down never costs a stage recompute."
+).integer(10)
+
+CLUSTER_AUTOSCALE_COOLDOWN_MS = conf(
+    "spark.rapids.sql.cluster.autoscale.cooldownMs").doc(
+    "Minimum wall time between two autoscaling decisions (either "
+    "direction). With the scaleDownIdleS dwell this is the "
+    "hysteresis that makes the loop converge instead of flapping "
+    "around the target.").integer(5000)
+
+CLUSTER_SUPERVISOR_POLL_MS = conf(
+    "spark.rapids.sql.cluster.supervisor.pollMs").doc(
+    "Supervisor control-loop tick (parallel/cluster/supervisor.py): "
+    "how often worker processes are reaped, restart backoffs "
+    "re-evaluated, and straggler statistics pulled from the "
+    "coordinator.").integer(250)
+
+CLUSTER_SUPERVISOR_BACKOFF_BASE_MS = conf(
+    "spark.rapids.sql.cluster.supervisor.restartBackoffBaseMs").doc(
+    "First restart delay after a supervised worker dies; each "
+    "consecutive death doubles it (deterministic exponential "
+    "schedule) up to restartBackoffCapMs. A worker that completes a "
+    "task resets its schedule.").integer(250)
+
+CLUSTER_SUPERVISOR_BACKOFF_CAP_MS = conf(
+    "spark.rapids.sql.cluster.supervisor.restartBackoffCapMs").doc(
+    "Upper bound on the exponential restart backoff.").integer(10000)
+
+CLUSTER_SUPERVISOR_CRASH_LOOP_WINDOW_MS = conf(
+    "spark.rapids.sql.cluster.supervisor.crashLoopWindowMs").doc(
+    "Crash-loop detection window: a worker that dies "
+    "crashLoopThreshold times within this window is QUARANTINED — "
+    "held out of the pool with a typed reason "
+    "(srt_quarantined_workers gauge + worker-quarantined event-log "
+    "instant) instead of being respawned forever.").integer(30000)
+
+CLUSTER_SUPERVISOR_CRASH_LOOP_THRESHOLD = conf(
+    "spark.rapids.sql.cluster.supervisor.crashLoopThreshold").doc(
+    "Deaths within crashLoopWindowMs that quarantine a worker."
+).integer(3)
+
+CLUSTER_SUPERVISOR_STRAGGLER_FACTOR = conf(
+    "spark.rapids.sql.cluster.supervisor.stragglerFactor").doc(
+    "Straggler demotion threshold: a worker whose median CBEAT "
+    "heartbeat interval or per-stage wall exceeds this multiple of "
+    "the fleet median is demoted below steal-delay placement "
+    "preference (CDEMO — the same pressure-shed tier as "
+    "scheduler.pressure.shedScore), and promoted back once it "
+    "recovers under factor*0.5.").double(3.0)
+
+CLUSTER_SUPERVISOR_STRAGGLER_MIN_SAMPLES = conf(
+    "spark.rapids.sql.cluster.supervisor.stragglerMinSamples").doc(
+    "Minimum per-worker samples (heartbeat intervals or stage walls) "
+    "before the straggler detector may judge it — outlier math on "
+    "two points demotes noise.").integer(5)
+
+CLUSTER_SUPERVISOR_DRAIN_TIMEOUT_MS = conf(
+    "spark.rapids.sql.cluster.supervisor.drainTimeoutMs").doc(
+    "How long a drain (CDRAIN) may wait for the worker's in-flight "
+    "stages to commit before the supervisor escalates to terminating "
+    "the process anyway (the heartbeat sweep then requeues whatever "
+    "was left RUNNING).").integer(30000)
+
 BROADCAST_CACHE_ENABLED = conf(
     "spark.rapids.sql.broadcast.cache.enabled").doc(
     "Cluster-wide broadcast artifact cache: the first process to "

@@ -202,7 +202,45 @@ def log_query(phys, ctx, *, query_id: int, status: str,
             "event-log write failed", exc_info=True)
 
 
+def log_fleet(event: str, **fields) -> None:
+    """Append one FLEET record (type='fleet') to the event log: the
+    supervisor/autoscaler control plane's observable trail (ISSUE 20).
+    Records land in ``fleet-<pid>.jsonl`` next to the per-query files,
+    so the soak can replay worker count vs load off the same directory
+    a history server already reads. Shape:
+
+        {"v": 1, "ts": ..., "type": "fleet", "event": "scale-up",
+         "workers": 3, "target": 4, ...}
+
+    No-op when the event-log dir is unset; never fails the caller."""
+    d = _DIR
+    if not d:
+        return
+    try:
+        import time
+        rec = {"v": SCHEMA_VERSION, "ts": time.time(), "type": "fleet",
+               "event": str(event)}
+        rec.update({k: _json_safe(v) for k, v in fields.items()})
+        line = json.dumps(rec, sort_keys=True)
+        path = os.path.join(d, f"fleet-{os.getpid()}.jsonl")
+        with _LOCK:
+            os.makedirs(d, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(line + "\n")
+    except Exception:
+        import logging
+        logging.getLogger("spark_rapids_tpu").warning(
+            "fleet event-log write failed", exc_info=True)
+
+
 # -- readers (the history-server side) ----------------------------------------
+
+def read_fleet_events(path: str) -> List[dict]:
+    """The fleet-control subset of :func:`read_events` (type='fleet'),
+    oldest first: scale decisions, restarts, quarantines, drains and
+    periodic worker-count samples."""
+    return [r for r in read_events(path) if r.get("type") == "fleet"]
+
 
 def read_events(path: str) -> List[dict]:
     """Load records from one ``.jsonl`` file or every ``events-*.jsonl``
@@ -277,6 +315,8 @@ def fleet_summary(records: List[dict]) -> dict:
     durs: List[float] = []
     cache_hits = 0
     for r in records:
+        if r.get("type") == "fleet":
+            continue            # control-plane records, not queries
         by_status[r.get("status", "?")] = \
             by_status.get(r.get("status", "?"), 0) + 1
         c = r.get("class") or "-"

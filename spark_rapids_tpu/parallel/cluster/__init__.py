@@ -27,13 +27,26 @@ replaying the journal on restart; drivers opt into the out-of-process
 coordinator with ``cluster.coordinator.remote=true`` (:mod:`remote`)
 and ride out the restart window instead of failing; workers reconnect
 with capped backoff instead of dying on a refused poll.
+
+Self-healing (ISSUE 20): :mod:`supervisor` owns the worker pool —
+restart with exponential backoff, crash-loop quarantine, straggler
+demotion (``CDEMO``) and clean drain/retire (``CDRAIN``/``CRETIRE``);
+:mod:`autoscaler` sizes the pool against the ``cluster.autoscale.*``
+SLO knobs and defers brownout to a scale-up attempt while headroom
+remains. ``scripts/cluster.py --supervise`` is the standalone entry.
 """
 
+from spark_rapids_tpu.parallel.cluster.autoscaler import (    # noqa: F401
+    Autoscaler, ScalerState, decide)
 from spark_rapids_tpu.parallel.cluster.coordinator import (   # noqa: F401
     ClusterCoordinator, ClusterDispatchError, ClusterExecInfo, QueryRun,
-    cluster_enabled, cluster_store_kind, get_coordinator, maybe_prepare,
-    merge_worker_reports, shutdown_coordinator, stage_plan)
+    cluster_enabled, cluster_store_kind, dispatch_timeout_error,
+    get_coordinator, maybe_prepare, merge_worker_reports,
+    shutdown_coordinator, stage_plan)
 from spark_rapids_tpu.parallel.cluster.journal import (       # noqa: F401
     Journal, replay_state)
 from spark_rapids_tpu.parallel.cluster.remote import (        # noqa: F401
     RemoteQueryRun, remote_prepare)
+from spark_rapids_tpu.parallel.cluster.supervisor import (    # noqa: F401
+    Supervisor, drain_order, is_crash_looping, restart_backoff_ms,
+    straggler_verdicts)

@@ -1,0 +1,267 @@
+"""SLO-driven autoscaler (ISSUE 20 tentpole, second half).
+
+The :class:`Supervisor` keeps a pool *healthy*; this module decides how
+BIG the pool should be. One control loop scrapes the fleet signals the
+scheduler already publishes —
+
+- ``srt_admission_queued_ms`` (how long admitted queries waited),
+- admission queue depth (:meth:`QueryManager.queued_count`),
+- the dispatch pressure score (the brownout input), and
+- coordinator per-worker busyness (CSTATS ``inflight``)
+
+— and compares them against the ``cluster.autoscale.*`` SLO knobs.
+Above target it spawns ``scaleUpStep`` workers through the supervisor;
+once the fleet has been comfortably under target for
+``scaleDownIdleS`` it drains ONE worker (CDRAIN → manifests commit →
+CRETIRE), so scale-down never costs a stage recompute. ``cooldownMs``
+gates consecutive decisions and the idle clock restarts after every
+action, giving the loop classic hysteresis: fast up, slow down.
+
+Brownout interplay: while an autoscaler is live it registers itself as
+the scheduler's *scale probe* (:func:`scheduler.register_scale_probe`),
+so sustained pressure first defers brownout by one window and triggers
+a scale-up attempt; load shedding only engages once the fleet is
+already at ``maxWorkers`` (or the probe declines). Capacity before
+degradation.
+
+The decision function is pure (:func:`decide`) so the policy is
+unit-testable without processes; the :class:`Autoscaler` is the thin
+wall-clock loop around it. Nothing here runs unless
+``spark.rapids.sql.cluster.autoscale.enabled`` is flipped on.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from spark_rapids_tpu import config as C
+from spark_rapids_tpu.parallel.cluster.supervisor import Supervisor
+
+_LOG = logging.getLogger("spark_rapids_tpu.cluster.autoscaler")
+
+SCALE_UP = "up"
+SCALE_DOWN = "down"
+HOLD = "hold"
+
+
+class ScalerState:
+    """Mutable hysteresis state :func:`decide` folds over: when the
+    last action fired (cooldown) and since when the fleet has been
+    continuously under target (the scale-down idle clock)."""
+
+    __slots__ = ("last_action_at", "under_target_since")
+
+    def __init__(self):
+        self.last_action_at: Optional[float] = None
+        self.under_target_since: Optional[float] = None
+
+
+def decide(now: float, current: int, signals: Dict[str, float],
+           state: ScalerState, *, min_workers: int, max_workers: int,
+           target_queued_ms: float, scale_up_step: int,
+           scale_down_idle_s: float, cooldown_ms: float) -> dict:
+    """One pure scaling decision.
+
+    ``signals``: ``queued_ms`` (recent admission-wait quantile, ms),
+    ``queue_depth`` (queries waiting for a slot), ``busy`` (workers
+    with an in-flight stage), ``pressure`` (dispatch pressure score).
+    Returns ``{"action": up|down|hold, "target": int, "reason": str}``
+    with ``target == current`` on hold. The caller owns acting on it
+    AND stamping ``state.last_action_at`` only when it really acted.
+    """
+    current = max(int(current), 0)
+    queued_ms = float(signals.get("queued_ms", 0.0) or 0.0)
+    depth = int(signals.get("queue_depth", 0) or 0)
+    busy = int(signals.get("busy", 0) or 0)
+    pressure = float(signals.get("pressure", 0.0) or 0.0)
+
+    over = (queued_ms > target_queued_ms
+            or (depth > 0 and busy >= current)
+            or pressure >= 1.0)
+    if over:
+        # Any overload sign resets the idle clock even when the
+        # cooldown (or the ceiling) blocks acting on it.
+        state.under_target_since = None
+
+    in_cooldown = (state.last_action_at is not None
+                   and (now - state.last_action_at) * 1000.0
+                   < cooldown_ms)
+    if in_cooldown:
+        return {"action": HOLD, "target": current,
+                "reason": "cooldown"}
+
+    if over:
+        target = min(current + max(int(scale_up_step), 1),
+                     int(max_workers))
+        if target > current:
+            return {"action": SCALE_UP, "target": target,
+                    "reason": (f"queued_ms={queued_ms:.0f} "
+                               f"depth={depth} busy={busy}/{current} "
+                               f"pressure={pressure:.2f}")}
+        return {"action": HOLD, "target": current,
+                "reason": "at-max-workers"}
+
+    if current > int(min_workers):
+        if state.under_target_since is None:
+            state.under_target_since = now
+            return {"action": HOLD, "target": current,
+                    "reason": "idle-clock-started"}
+        idle_s = now - state.under_target_since
+        if idle_s >= float(scale_down_idle_s):
+            return {"action": SCALE_DOWN, "target": current - 1,
+                    "reason": f"under-target {idle_s:.1f}s"}
+        return {"action": HOLD, "target": current,
+                "reason": f"idle {idle_s:.1f}s/"
+                          f"{scale_down_idle_s:.0f}s"}
+    return {"action": HOLD, "target": current,
+            "reason": "at-min-workers"}
+
+
+class Autoscaler:
+    """Wall-clock loop: gather signals → :func:`decide` → act through
+    the supervisor. Also the scheduler's scale probe while alive, so
+    brownout defers to a scale-up attempt when headroom remains."""
+
+    def __init__(self, supervisor: Supervisor, conf=None,
+                 signals_fn=None):
+        conf = conf if conf is not None else C.TpuConf({})
+        self.sup = supervisor
+        self.conf = conf
+        self.min_workers = max(
+            int(conf.get(C.CLUSTER_AUTOSCALE_MIN_WORKERS)), 0)
+        self.max_workers = max(
+            int(conf.get(C.CLUSTER_AUTOSCALE_MAX_WORKERS)),
+            self.min_workers)
+        self.target_queued_ms = float(
+            conf.get(C.CLUSTER_AUTOSCALE_TARGET_QUEUED_MS))
+        self.scale_up_step = int(
+            conf.get(C.CLUSTER_AUTOSCALE_SCALE_UP_STEP))
+        self.scale_down_idle_s = float(
+            conf.get(C.CLUSTER_AUTOSCALE_SCALE_DOWN_IDLE_S))
+        self.cooldown_ms = float(
+            conf.get(C.CLUSTER_AUTOSCALE_COOLDOWN_MS))
+        self._signals_fn = signals_fn
+        self.state = ScalerState()
+        self.decisions = {"up": 0, "down": 0}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- signal scraping -----------------------------------------------------
+    def gather_signals(self) -> Dict[str, float]:
+        if self._signals_fn is not None:
+            return dict(self._signals_fn())
+        sig: Dict[str, float] = {"queued_ms": 0.0, "queue_depth": 0,
+                                 "busy": 0, "pressure": 0.0}
+        try:
+            from spark_rapids_tpu.parallel import scheduler as S
+            qm = S.get_query_manager(self.conf)._current()
+            sig["queue_depth"] = int(qm.queued_count)
+            sig["pressure"] = float(qm._pressure_score)
+        except Exception:
+            pass
+        try:
+            from spark_rapids_tpu.monitoring import telemetry
+            if telemetry.enabled():
+                snap = telemetry.snapshot()
+                m = snap["metrics"].get("srt_admission_queued_ms")
+                if m:
+                    p95s = [s.get("p95") for s in m["series"]
+                            if s.get("p95") == s.get("p95")]  # no NaN
+                    if p95s:
+                        sig["queued_ms"] = max(p95s)
+        except Exception:
+            pass
+        stats = self.sup._coordinator_stats()
+        if stats:
+            sig["busy"] = sum(
+                1 for w in stats.get("workers", {}).values()
+                if w.get("alive") and w.get("inflight", 0) > 0)
+        return sig
+
+    # -- brownout scale probe ------------------------------------------------
+    def scale_probe(self, score: float) -> bool:
+        """Called by the scheduler when pressure has sustained long
+        enough to brown out. Returns True (defer brownout one window)
+        when a scale-up was possible and has been requested; False
+        (shed load now) once the fleet is at max."""
+        current = self.sup.active_count()
+        if current >= self.max_workers:
+            return False
+        self._act({"action": SCALE_UP,
+                   "target": min(current + max(self.scale_up_step, 1),
+                                 self.max_workers),
+                   "reason": f"brownout-probe pressure={score:.2f}"},
+                  current)
+        return True
+
+    # -- loop ----------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        current = self.sup.active_count()
+        if current < self.min_workers:
+            # The floor is not a scaling decision: quarantines or
+            # failed restarts dropping the fleet under minWorkers are
+            # replaced immediately, cooldown or not.
+            self.sup.scale_to(self.min_workers)
+            return {"action": SCALE_UP, "target": self.min_workers,
+                    "reason": "below-min-workers"}
+        d = decide(now, current, self.gather_signals(), self.state,
+                   min_workers=self.min_workers,
+                   max_workers=self.max_workers,
+                   target_queued_ms=self.target_queued_ms,
+                   scale_up_step=self.scale_up_step,
+                   scale_down_idle_s=self.scale_down_idle_s,
+                   cooldown_ms=self.cooldown_ms)
+        if d["action"] != HOLD:
+            self._act(d, current, now=now)
+        return d
+
+    def _act(self, d: dict, current: int,
+             now: Optional[float] = None) -> None:
+        from spark_rapids_tpu import monitoring
+        from spark_rapids_tpu.monitoring import telemetry
+        self.state.last_action_at = \
+            time.monotonic() if now is None else now
+        self.state.under_target_since = None
+        self.decisions[d["action"]] = \
+            self.decisions.get(d["action"], 0) + 1
+        _LOG.info("autoscale %s: %d -> %d (%s)", d["action"], current,
+                  d["target"], d["reason"])
+        monitoring.instant(f"autoscale-{d['action']}", "cluster",
+                           args={"from": current, "to": d["target"],
+                                 "reason": d["reason"]})
+        if telemetry.enabled():
+            telemetry.inc(f"srt_autoscale_{d['action']}")
+            telemetry.set_gauge("srt_fleet_target", d["target"])
+        self.sup._log_fleet(f"autoscale-{d['action']}",
+                            current=current, target=d["target"],
+                            reason=d["reason"])
+        self.sup.scale_to(d["target"])
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        from spark_rapids_tpu.parallel import scheduler as S
+        S.register_scale_probe(self.scale_probe)
+        self._thread = threading.Thread(
+            target=self._run, name="srt-autoscaler", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.sup.poll_ms / 1000.0):
+            try:
+                self.tick()
+            except Exception:
+                _LOG.exception("autoscaler tick failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        from spark_rapids_tpu.parallel import scheduler as S
+        S.register_scale_probe(None)

@@ -20,6 +20,18 @@ rendezvous grammar):
     CDONE <wid> <qid> <sid> <gen> <bytes>        -> OK
     CFAIL <wid> <qid> <sid> <gen> <lost|-> <b64> -> OK
     CSTATS                                       -> OK <b64 json>
+    CDRAIN <wid>                                 -> OK
+    CDEMO <wid> <0|1>                            -> OK
+
+Self-healing verbs (ISSUE 20): ``CDRAIN`` marks a worker draining —
+the coordinator stops offering it stage tasks, lets its in-flight
+stages commit their manifests, and answers its next idle ``CPOLL``
+with ``CRETIRE`` so the worker exits cleanly (scale-down and
+``--max-idle-s`` self-retirement never cost a stage recompute or a
+heartbeat-timeout wait). ``CDEMO`` toggles the supervisor's straggler
+demotion: a demoted worker drops below steal-delay placement
+preference exactly like a pressure-shed worker (scheduler.pressure.*)
+until the supervisor promotes it back.
 
 Scheduling is pull-based: an idle worker polls and the coordinator
 picks, among the READY tasks (all deps committed, dispatch gate of
@@ -61,17 +73,66 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from spark_rapids_tpu import config as C
+from spark_rapids_tpu.parallel.scheduler import QueryRejectedError
 from spark_rapids_tpu.parallel.transport.rendezvous import RendezvousServer
 
 _LOG = logging.getLogger("spark_rapids_tpu.cluster")
 
 _PENDING, _RUNNING, _DONE = "pending", "running", "done"
 
+# How long a retired wid's stray heartbeats are ignored before the id
+# may be reused by a fresh CREG (the retiring worker's daemon beat
+# thread may land one more CBEAT after its CRETIRE).
+_RETIRE_GRACE_S = 10.0
 
-class ClusterDispatchError(RuntimeError):
+
+class ClusterDispatchError(QueryRejectedError):
     """A query's stage-task set could not be completed (dispatch
     timeout, task retry budget exhausted, or a worker-reported
-    non-recoverable stage failure)."""
+    non-recoverable stage failure).
+
+    Subclasses :class:`QueryRejectedError` so the dispatch-timeout
+    variant participates in the PR 16 backpressure contract: the
+    coordinator barrier raises it with ``kind='dispatch-timeout'`` and
+    a ``retry_after_ms`` hint, so after the planner's transient ladder
+    is exhausted ``collect_with_retry`` backs off and resubmits instead
+    of re-raising. Every other variant (retry budget exhausted, worker
+    stage error) ships no hint — retrying as-is cannot help. The
+    message is NOT rewritten into the ``REJECTED:`` shape: dispatch
+    timeouts keep their ``UNAVAILABLE:`` marker so
+    ``is_transient_error`` still routes them into the recovery ladder
+    first."""
+
+    def __init__(self, message: str, kind: str = "dispatch",
+                 queue_depth: Optional[int] = None,
+                 retry_after_ms: Optional[float] = None):
+        RuntimeError.__init__(self, message)
+        self.reason = message
+        self.kind = kind
+        self.queue_depth = queue_depth
+        self.retry_after_ms = retry_after_ms
+
+
+def dispatch_timeout_error(message: str,
+                           queue_depth: Optional[int] = None,
+                           retry_after_ms: Optional[float] = None
+                           ) -> ClusterDispatchError:
+    """The dispatch-timeout rejection (ISSUE 20 satellite): built with
+    ``kind='dispatch-timeout'`` and a ``retry_after_ms`` hint — never
+    hintless — and recorded in the same structured shed-load telemetry
+    as every scheduler rejection, so ``srt_queries_rejected`` and the
+    retry-hint gauges cover coordinator-barrier sheds too."""
+    hint = float(retry_after_ms) if retry_after_ms and \
+        retry_after_ms > 0 else 250.0
+    try:
+        from spark_rapids_tpu.parallel.scheduler import _telemetry_reject
+        _telemetry_reject("dispatch-timeout", int(queue_depth or 0),
+                          hint)
+    except Exception:                  # telemetry must never mask the
+        pass                           # rejection itself
+    return ClusterDispatchError(message, kind="dispatch-timeout",
+                                queue_depth=queue_depth,
+                                retry_after_ms=hint)
 
 
 def cluster_enabled(conf) -> bool:
@@ -349,7 +410,7 @@ def merge_worker_reports(ctx, root, reports: Dict[str, dict]) -> None:
 
 class _StageTask:
     __slots__ = ("sid", "deps", "status", "worker", "gen", "retries",
-                 "bytes", "producer", "ready_ts")
+                 "bytes", "producer", "ready_ts", "started_ts")
 
     def __init__(self, sid: int, deps: Set[int]):
         self.sid = sid
@@ -361,10 +422,13 @@ class _StageTask:
         self.bytes = 0
         self.producer: Optional[str] = None
         self.ready_ts: Optional[float] = None   # first observed ready
+        self.started_ts: Optional[float] = None  # last dispatch time
 
 
 class _WorkerInfo:
-    __slots__ = ("wid", "last_seen", "alive", "completed", "pressure")
+    __slots__ = ("wid", "last_seen", "alive", "completed", "pressure",
+                 "draining", "demoted", "last_beat", "beat_ms",
+                 "stage_wall_ms", "incarnation")
 
     def __init__(self, wid: str, now: float):
         self.wid = wid
@@ -375,6 +439,29 @@ class _WorkerInfo:
         # telemetry piggyback (0.0 until it reports one): the signal
         # shed-aware placement demotes loaded workers on.
         self.pressure = 0.0
+        # Self-healing plane (ISSUE 20): draining workers get no new
+        # stage tasks and retire on their next idle CPOLL; demoted
+        # (straggler) workers drop below steal-delay preference like
+        # pressure-shed ones until the supervisor promotes them back.
+        self.draining = False
+        self.demoted = False
+        # Straggler evidence the supervisor's outlier detector pulls
+        # through CSTATS: recent CBEAT inter-arrival gaps and per-stage
+        # dispatch->commit walls, both in ms (bounded ring).
+        self.last_beat: Optional[float] = None
+        self.beat_ms: List[float] = []
+        self.stage_wall_ms: List[float] = []
+        # Per-PROCESS token off CREG: a re-register under the same wid
+        # with a DIFFERENT token is proof the previous incarnation died
+        # (supervisor restart racing the heartbeat sweep) — its RUNNING
+        # stages requeue immediately instead of orphaning.
+        self.incarnation: Optional[str] = None
+
+    def sample(self, ring: List[float], value_ms: float,
+               cap: int = 32) -> None:
+        ring.append(round(value_ms, 3))
+        if len(ring) > cap:
+            del ring[:len(ring) - cap]
 
 
 class QueryRun:
@@ -481,10 +568,15 @@ class QueryRun:
             if done:
                 break
             if time.monotonic() > deadline:
-                raise ClusterDispatchError(
+                with self.co._lock:
+                    depth = sum(1 for t in self.tasks.values()
+                                if t.status != _DONE)
+                    hint = self.co._dispatch_retry_hint_locked(depth)
+                raise dispatch_timeout_error(
                     f"UNAVAILABLE: cluster dispatch of query {self.qid} "
                     f"incomplete after {self.dispatch_timeout_ms}ms "
-                    f"({self._progress()})")
+                    f"({self._progress()})",
+                    queue_depth=depth, retry_after_ms=hint)
             time.sleep(self.poll_ms / 1000.0)
         m = self._metrics()
         m.add("dispatchWaitMs", (time.monotonic() - t0) * 1000.0)
@@ -555,6 +647,8 @@ class QueryRun:
             self.co.queries.pop(self.qid, None)
             none_active = not self.co.queries
             wids = self.co._alive_wids_locked()
+            draining = [w.wid for w in self.co.workers.values()
+                        if w.alive and w.draining]
         shutil.rmtree(self.qdir, ignore_errors=True)
         if not self.pkl_path.startswith(self.qdir + os.sep):
             try:                 # remote submissions park the plan
@@ -576,7 +670,8 @@ class QueryRun:
             self.co.journal.rewrite(
                 replays[-8:] +
                 [{"t": "reg", "wid": w, "ts": time.time()}
-                 for w in wids])
+                 for w in wids] +
+                [{"t": "drain", "wid": w} for w in draining])
 
     # -- coordinator side (lock held) ----------------------------------------
     def _clear_stage_store_locked(self, sid: int) -> None:
@@ -665,8 +760,11 @@ class QueryRun:
             if not os.path.exists(self.pkl_path):
                 return None
             self._pkl_ready = True
-        alive = self.co._alive_wids_locked()
-        if len(alive) < self.min_workers:
+        # Draining workers are not placement targets: they fall out of
+        # the dispatch gate, the locality ranking AND rendezvous-hash
+        # ownership, so their remaining work commits and they retire.
+        alive = self.co._placeable_wids_locked()
+        if wid not in alive or len(alive) < self.min_workers:
             return None
         ready = self._ready_locked()
         if not ready:
@@ -687,10 +785,15 @@ class QueryRun:
             worker sheds new stages to its peers instead of spilling
             under them. All-pressured (or the gate off) collapses the
             tier to a constant — placement is exactly the old
-            (locality, affinity) order."""
+            (locality, affinity) order. Supervisor straggler demotion
+            (CDEMO) rides the SAME tier: a demoted worker only gets a
+            stage when every healthy peer is busy past the
+            reservation window."""
+            info = self.co.workers.get(w)
+            if info is not None and info.demoted:
+                return 0
             if not self.pressure_enabled:
                 return 1
-            info = self.co.workers.get(w)
             if info is None or info.pressure < self.shed_score:
                 return 1
             return 0
@@ -724,6 +827,7 @@ class QueryRun:
         best = max(ready, key=lambda t: rank(t, wid) + (-t.sid,))
         best.status = _RUNNING
         best.worker = wid
+        best.started_ts = now
         depgens = ",".join(f"{d}:{self.tasks[d].gen}"
                            for d in sorted(best.deps)) or "-"
         line = (f"CTASK {self.qid} {best.sid} {best.gen} {depgens} "
@@ -748,6 +852,11 @@ class QueryRun:
         w = self.co.workers.get(wid)
         if w is not None:
             w.completed += 1
+            if t.started_ts is not None:
+                # Dispatch->commit wall sample: the supervisor's
+                # straggler detector compares these across the fleet.
+                w.sample(w.stage_wall_ms,
+                         (time.monotonic() - t.started_ts) * 1000.0)
         if self._ctx is not None:
             self._metrics().add("stagesCompleted", 1)
 
@@ -791,6 +900,10 @@ class ClusterCoordinator:
         self._lock = threading.Lock()
         self.workers: Dict[str, _WorkerInfo] = {}
         self.queries: Dict[int, QueryRun] = {}
+        # Cleanly retired wids (CDRAIN -> CRETIRE) with the deadline
+        # until which their stray daemon-thread heartbeats are ignored;
+        # an explicit CREG re-admits the id immediately.
+        self._retired: Dict[str, float] = {}
         self._next_qid = 1
         self.base_dir = str(conf.get(C.CLUSTER_DIR) or "") or \
             os.path.join(tempfile.gettempdir(),
@@ -896,6 +1009,10 @@ class ClusterCoordinator:
         now = time.monotonic()
         for wid in state["workers"]:
             self.workers[wid] = _WorkerInfo(wid, now)
+        for wid in state.get("draining", ()):
+            w = self.workers.get(wid)
+            if w is not None:
+                w.draining = True
         recovered: List[int] = []
         for qid in sorted(state["queries"]):
             qs = state["queries"][qid]
@@ -964,8 +1081,56 @@ class ClusterCoordinator:
     def _alive_wids_locked(self) -> List[str]:
         return [w.wid for w in self.workers.values() if w.alive]
 
-    def _touch_locked(self, wid: str) -> _WorkerInfo:
+    def _placeable_wids_locked(self) -> List[str]:
+        """Workers new stage tasks may land on: alive and NOT
+        draining. Draining workers keep finishing their in-flight
+        stages but drop out of the dispatch gate, locality ranking and
+        rendezvous-hash ownership."""
+        return [w.wid for w in self.workers.values()
+                if w.alive and not w.draining]
+
+    def _dispatch_retry_hint_locked(self, pending: int) -> float:
+        """retry_after_ms for a dispatch-timeout rejection: the
+        not-yet-done stage count drained at the fleet's observed mean
+        stage wall (250ms prior before any stage has committed),
+        spread over the live workers."""
+        walls = [v for w in self.workers.values()
+                 for v in w.stage_wall_ms]
+        base = sum(walls) / len(walls) if walls else 250.0
+        alive = max(self._alive_count_locked(), 1)
+        return round(max(250.0, base * max(pending, 1) / alive), 1)
+
+    def _inflight_locked(self, wid: str) -> int:
+        return sum(1 for q in self.queries.values()
+                   for t in q.tasks.values()
+                   if t.status == _RUNNING and t.worker == wid)
+
+    def _retire_locked(self, w: _WorkerInfo) -> None:
+        """Drain completion: drop the worker from membership as a
+        CLEAN retirement — no death counter, no requeue (it has no
+        RUNNING task by construction) — and shield its id against the
+        stray heartbeat race."""
+        from spark_rapids_tpu import faults, monitoring
+        self.workers.pop(w.wid, None)
+        self._retired[w.wid] = time.monotonic() + _RETIRE_GRACE_S
+        faults.record("clusterWorkerRetirements")
+        monitoring.instant("worker-retired", "cluster",
+                           args={"worker": w.wid,
+                                 "completed": w.completed})
+        self._jlog({"t": "retire", "wid": w.wid})
+        _LOG.info("cluster: worker %s drained and retired cleanly "
+                  "(%d stage task(s) completed)", w.wid, w.completed)
+
+    def _touch_locked(self, wid: str) -> Optional[_WorkerInfo]:
         now = time.monotonic()
+        exp = self._retired.get(wid)
+        if exp is not None:
+            if now < exp:
+                # A retired worker's daemon beat thread may land one
+                # last CBEAT after its CRETIRE: swallowing it keeps the
+                # id from being resurrected as a ghost member.
+                return None
+            del self._retired[wid]
         w = self.workers.get(wid)
         if w is None or not w.alive:
             from spark_rapids_tpu import monitoring
@@ -988,21 +1153,27 @@ class ClusterCoordinator:
             if not w.alive or \
                     (now - w.last_seen) * 1000.0 < self.hb_timeout_ms:
                 continue
-            w.alive = False
-            from spark_rapids_tpu import faults, monitoring
-            faults.record("clusterWorkerDeaths")
-            monitoring.instant("worker-death", "recovery",
-                               args={"worker": w.wid})
-            _LOG.warning("cluster: worker %s heartbeat silent for "
-                         ">%dms — declared dead; requeueing its tasks",
-                         w.wid, self.hb_timeout_ms)
-            for q in self.queries.values():
-                for t in q.tasks.values():
-                    if t.status == _RUNNING and t.worker == w.wid:
-                        if q._ctx is not None:
-                            q._metrics().add("workerDeaths", 1)
-                        q._requeue_locked(
-                            t, f"worker {w.wid} died mid-stage")
+            self._declare_dead_locked(
+                w, f"heartbeat silent for >{self.hb_timeout_ms}ms")
+
+    def _declare_dead_locked(self, w: _WorkerInfo, why: str) -> None:
+        """Declare one worker dead and requeue every RUNNING task it
+        held across all active queries. Shared by the heartbeat sweep
+        and the CREG incarnation check."""
+        w.alive = False
+        from spark_rapids_tpu import faults, monitoring
+        faults.record("clusterWorkerDeaths")
+        monitoring.instant("worker-death", "recovery",
+                           args={"worker": w.wid})
+        _LOG.warning("cluster: worker %s %s — declared dead; "
+                     "requeueing its tasks", w.wid, why)
+        for q in self.queries.values():
+            for t in q.tasks.values():
+                if t.status == _RUNNING and t.worker == w.wid:
+                    if q._ctx is not None:
+                        q._metrics().add("workerDeaths", 1)
+                    q._requeue_locked(
+                        t, f"worker {w.wid} died mid-stage")
 
     def dispatch(self, parts: List[str]) -> Optional[bytes]:
         try:
@@ -1013,13 +1184,41 @@ class ClusterCoordinator:
 
     def _dispatch(self, parts: List[str]) -> Optional[bytes]:
         cmd = parts[0].upper()
-        if cmd == "CREG" and len(parts) == 2:
+        if cmd == "CREG" and len(parts) in (2, 3):
+            wid = parts[1]
+            token = parts[2] if len(parts) == 3 else None
             with self._lock:
-                self._touch_locked(parts[1])
+                # An explicit re-register always re-admits the id —
+                # retirement only shields against STRAY beats.
+                self._retired.pop(wid, None)
+                w = self.workers.get(wid)
+                if (token is not None and w is not None and w.alive
+                        and w.incarnation is not None
+                        and w.incarnation != token):
+                    # Same wid, different process: the supervisor's
+                    # replacement registered before the heartbeat sweep
+                    # noticed the old incarnation's silence. Without
+                    # this the dead process's RUNNING stages would stay
+                    # assigned to a wid that keeps beating — a
+                    # permanent dispatch stall.
+                    self._declare_dead_locked(
+                        w, "re-registered under a new incarnation "
+                           f"({w.incarnation} -> {token})")
+                w = self._touch_locked(wid)
+                if token is not None and w is not None:
+                    w.incarnation = token
             return b"OK\n"
         if cmd == "CBEAT" and len(parts) in (2, 3):
             with self._lock:
-                self._touch_locked(parts[1])
+                w = self._touch_locked(parts[1])
+                if w is not None:
+                    # Heartbeat inter-arrival ring: the supervisor's
+                    # straggler detector reads these through CSTATS.
+                    now = time.monotonic()
+                    if w.last_beat is not None:
+                        w.sample(w.beat_ms,
+                                 (now - w.last_beat) * 1000.0)
+                    w.last_beat = now
             if len(parts) == 3:
                 # Telemetry piggyback (monitoring/telemetry.py): the
                 # worker's flattened registry feeds the driver's fleet
@@ -1048,7 +1247,16 @@ class ClusterCoordinator:
         if cmd == "CPOLL" and len(parts) == 3:
             wid, known = parts[1], parts[2]
             with self._lock:
-                self._touch_locked(wid)
+                w = self._touch_locked(wid)
+                if w is None:
+                    # Still inside the retire grace window: repeat the
+                    # retire answer (idempotent) instead of ghosting.
+                    return b"CRETIRE\n"
+                if w.draining:
+                    if self._inflight_locked(wid) == 0:
+                        self._retire_locked(w)
+                        return b"CRETIRE\n"
+                    return b"CIDLE -\n"   # finish in-flight, no new work
                 stale = [q for q in known.split(",")
                          if q and q != "-"
                          and int(q) not in self.queries]
@@ -1093,6 +1301,47 @@ class ClusterCoordinator:
                     q._on_fail_locked(
                         wid, int(sid), int(gen),
                         None if lost == "-" else int(lost), msg)
+            return b"OK\n"
+        if cmd == "CDRAIN" and len(parts) == 2:
+            # Clean scale-down / self-retirement (ISSUE 20): stop
+            # dispatching to the worker; its in-flight stages commit,
+            # then its next idle CPOLL answers CRETIRE. Idempotent,
+            # and a no-op for unknown or already-retired ids.
+            wid = parts[1]
+            with self._lock:
+                w = self.workers.get(wid)
+                if w is not None and w.alive and not w.draining:
+                    w.draining = True
+                    from spark_rapids_tpu import monitoring
+                    monitoring.instant(
+                        "worker-drain", "cluster",
+                        args={"worker": wid,
+                              "inflight": self._inflight_locked(wid)})
+                    self._jlog({"t": "drain", "wid": wid})
+                    _LOG.info("cluster: worker %s draining (%d stage "
+                              "task(s) in flight)", wid,
+                              self._inflight_locked(wid))
+            return b"OK\n"
+        if cmd == "CDEMO" and len(parts) == 3:
+            # Straggler demotion toggle (supervisor): a demoted worker
+            # ranks below every non-demoted peer in CPOLL placement —
+            # the same tier pressure shedding uses — until promoted.
+            wid, flag = parts[1], parts[2] not in ("0", "false")
+            with self._lock:
+                w = self.workers.get(wid)
+                changed = w is not None and w.demoted != flag
+                if changed:
+                    w.demoted = flag
+            if changed:
+                from spark_rapids_tpu import monitoring
+                monitoring.instant(
+                    "worker-straggler" if flag else "worker-promoted",
+                    "cluster", args={"worker": wid})
+                _LOG.warning("cluster: worker %s %s steal-delay "
+                             "preference (straggler %s)", wid,
+                             "demoted below" if flag else
+                             "promoted back into",
+                             "demotion" if flag else "recovery")
             return b"OK\n"
         if cmd == "CSTATS" and len(parts) == 1:
             blob = base64.b64encode(
@@ -1164,8 +1413,15 @@ class ClusterCoordinator:
             return {
                 "workers": {
                     w.wid: {"alive": w.alive, "completed": w.completed,
-                            "idle_ms": int((now - w.last_seen) * 1000)}
+                            "idle_ms": int((now - w.last_seen) * 1000),
+                            "draining": w.draining,
+                            "demoted": w.demoted,
+                            "pressure": round(w.pressure, 4),
+                            "inflight": self._inflight_locked(w.wid),
+                            "beat_ms": list(w.beat_ms),
+                            "stage_wall_ms": list(w.stage_wall_ms)}
                     for w in self.workers.values()},
+                "retired": sorted(self._retired),
                 "queries": {
                     str(qid): {
                         str(t.sid): {"status": t.status,

@@ -120,6 +120,10 @@ def replay_state(recs: List[dict]) -> Dict:
 
     - ``workers``: wids seen registering (latest knowledge; liveness is
       re-established by their reconnect heartbeats),
+    - ``draining``: wids whose drain (CDRAIN) was journaled but whose
+      retirement was not — a restarted coordinator re-marks them
+      draining so the retire handshake completes instead of the worker
+      polling forever for its CRETIRE,
     - ``queries``: qid -> {"submit": rec, "tasks": {sid: {"status",
       "gen", "wid", "bytes", "retries"}}} for every UNFINISHED query,
     - ``next_qid``: one past the highest qid ever admitted.
@@ -127,6 +131,7 @@ def replay_state(recs: List[dict]) -> Dict:
     Pure function of the record list so it is unit-testable without a
     coordinator."""
     workers: List[str] = []
+    draining: List[str] = []
     queries: Dict[int, dict] = {}
     next_qid = 1
     for r in recs:
@@ -135,6 +140,18 @@ def replay_state(recs: List[dict]) -> Dict:
             wid = str(r.get("wid", ""))
             if wid and wid not in workers:
                 workers.append(wid)
+            if wid in draining:
+                draining.remove(wid)
+        elif t == "drain":
+            wid = str(r.get("wid", ""))
+            if wid in workers and wid not in draining:
+                draining.append(wid)
+        elif t == "retire":
+            wid = str(r.get("wid", ""))
+            if wid in workers:
+                workers.remove(wid)
+            if wid in draining:
+                draining.remove(wid)
         elif t == "submit":
             try:
                 qid = int(r["qid"])
@@ -182,5 +199,5 @@ def replay_state(recs: List[dict]) -> Dict:
                     task.update(status="pending", wid=None, bytes=0)
         elif t == "finish":
             queries.pop(r.get("qid"), None)
-    return {"workers": workers, "queries": queries,
-            "next_qid": next_qid}
+    return {"workers": workers, "draining": draining,
+            "queries": queries, "next_qid": next_qid}

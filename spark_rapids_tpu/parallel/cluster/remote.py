@@ -130,10 +130,13 @@ class RemoteQueryRun:
         while True:
             faults.check_cancelled()
             if time.monotonic() > deadline:
-                raise ClusterDispatchError(
+                from spark_rapids_tpu.parallel.cluster.coordinator \
+                    import dispatch_timeout_error
+                raise dispatch_timeout_error(
                     f"UNAVAILABLE: cluster dispatch of query {self.qid} "
                     f"incomplete after {self.dispatch_timeout_ms}ms "
-                    f"(remote coordinator)")
+                    f"(remote coordinator)",
+                    queue_depth=len(self.stages))
             try:
                 resp = self._call(f"CWAIT {self.qid}", timeout_s=5.0,
                                   retries=1)

@@ -96,6 +96,23 @@ class Worker:
         self.queries: Dict[int, _QueryState] = {}
         self._stop = threading.Event()
         self.tasks_done = 0
+        # Incarnation token: one value per PROCESS, sent with every
+        # CREG. The supervisor restarts a dead worker under the SAME
+        # wid (HRW placement re-converges), and on a loaded host the
+        # replacement can register BEFORE the heartbeat sweep notices
+        # the silence — without the token the coordinator would read
+        # that CREG as a beat from the old incarnation and its RUNNING
+        # stage would stay assigned forever. A token mismatch is proof
+        # of death; a reconnect after a coordinator outage reuses the
+        # same token and stays a no-op.
+        self.token = "%x.%x" % (os.getpid(),
+                                int(time.time() * 1000.0) & 0xFFFFFF)
+        # Self-retirement handshake (ISSUE 20 satellite): --max-idle-s
+        # expiry sends CDRAIN and waits for the coordinator's CRETIRE
+        # instead of silently exiting, so membership drops NOW rather
+        # than after heartbeatTimeoutMs of ghost liveness.
+        self._retiring = False
+        self._retire_deadline = 0.0
 
     # -- control plane --------------------------------------------------------
     def _call(self, line: str, timeout_s: float = 10.0) -> str:
@@ -147,7 +164,8 @@ class Worker:
             time.sleep(delay)
             delay = min(delay * 2, 2.0)
             try:
-                self._call(f"CREG {self.wid}", timeout_s=5.0)
+                self._call(f"CREG {self.wid} {self.token}",
+                           timeout_s=5.0)
             except RendezvousUnavailableError:
                 continue
             monitoring.instant("worker-reconnect", "recovery",
@@ -168,7 +186,7 @@ class Worker:
         end = time.monotonic() + deadline_s
         while True:
             try:
-                self._call(f"CREG {self.wid}")
+                self._call(f"CREG {self.wid} {self.token}")
                 return
             except RendezvousUnavailableError:
                 if time.monotonic() >= end:
@@ -448,6 +466,15 @@ class Worker:
                                  "exiting", self.wid, self.reconnect_s)
                     return 1
                 parts = resp.split()
+                if parts and parts[0] == "CRETIRE":
+                    # Clean retirement: the coordinator already dropped
+                    # this worker from membership (no heartbeat-timeout
+                    # wait, no death counter) — exit for real.
+                    monitoring.instant("worker-retire-ack", "cluster",
+                                       args={"worker": self.wid})
+                    _LOG.info("worker %s: retired by coordinator — "
+                              "exiting cleanly", self.wid)
+                    return 0
                 if parts and parts[0] == "CTASK":
                     qid, sid, gen = (int(parts[1]), int(parts[2]),
                                      int(parts[3]))
@@ -460,10 +487,34 @@ class Worker:
                     for q in parts[1].split(","):
                         if q:
                             self._close_query(int(q))
-                if self.max_idle_s and \
+                if self.max_idle_s and not self._retiring and \
                         time.monotonic() - idle_since > self.max_idle_s:
-                    _LOG.info("worker %s: idle %.0fs — exiting",
-                              self.wid, self.max_idle_s)
+                    # Deregister-then-exit (NOT a silent return): the
+                    # CDRAIN/CRETIRE handshake retires this worker at
+                    # the coordinator immediately; silently exiting
+                    # left a ghost member other dispatches waited
+                    # heartbeatTimeoutMs to bury.
+                    self._retiring = True
+                    self._retire_deadline = time.monotonic() + 10.0
+                    try:
+                        self._call(f"CDRAIN {self.wid}", timeout_s=5.0)
+                    except RendezvousUnavailableError:
+                        _LOG.info("worker %s: idle %.0fs and the "
+                                  "coordinator is gone — exiting",
+                                  self.wid, self.max_idle_s)
+                        return 0
+                    _LOG.info("worker %s: idle %.0fs — draining for "
+                              "clean retirement", self.wid,
+                              self.max_idle_s)
+                    delay_s = hot_s       # the CRETIRE is imminent
+                    continue
+                if self._retiring and \
+                        time.monotonic() > self._retire_deadline:
+                    # The CRETIRE never came (coordinator restarted
+                    # without its journal?): fall back to the old
+                    # silent exit rather than polling forever.
+                    _LOG.warning("worker %s: no CRETIRE within 10s of "
+                                 "CDRAIN — exiting anyway", self.wid)
                     return 0
                 if self.queries:
                     time.sleep(delay_s)

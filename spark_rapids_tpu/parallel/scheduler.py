@@ -575,6 +575,7 @@ class QueryManager:
             int(conf.get(C.PRESSURE_BROWNOUT_SUSTAIN_MS)), 0) / 1000.0
         now = time.perf_counter()
         flip = None
+        would_enter = False
         with self._lock:
             self._pressure_score = score
             if score >= enter:
@@ -582,13 +583,41 @@ class QueryManager:
                     self._pressure_high_since = now
                 if (not self.brownout_active
                         and now - self._pressure_high_since >= sustain_s):
-                    self.brownout_active = True
-                    flip = "enter"
+                    would_enter = True
             else:
                 self._pressure_high_since = None
                 if self.brownout_active and score < exit_below:
                     self.brownout_active = False
                     flip = "exit"
+        if would_enter:
+            # Autoscaler interplay (ISSUE 20): capacity BEFORE load
+            # shedding. When an autoscaler registered a scale probe and
+            # it accepts a scale-up (the fleet is below maxWorkers),
+            # brownout entry is deferred for one more sustain window so
+            # the new workers get a chance to absorb the pressure;
+            # only a fleet already at its ceiling browns out.
+            probe = _SCALE_PROBE
+            deferred = False
+            if probe is not None:
+                try:
+                    deferred = bool(probe(score))
+                except Exception:       # a broken probe must not wedge
+                    deferred = False    # the brownout safety valve
+            with self._lock:
+                if deferred:
+                    self._pressure_high_since = now
+                elif not self.brownout_active:
+                    self.brownout_active = True
+                    flip = "enter"
+            if deferred:
+                _record("brownoutDeferrals")
+                from spark_rapids_tpu import monitoring
+                monitoring.instant(
+                    "brownout-deferred-scaleup", "recovery",
+                    args={"pressureScore": round(score, 4)})
+                from spark_rapids_tpu.monitoring import telemetry
+                if telemetry.enabled():
+                    telemetry.inc("srt_brownout_deferrals")
         if flip is not None:
             _record("brownouts" if flip == "enter" else "brownoutExits")
             from spark_rapids_tpu import monitoring
@@ -756,6 +785,22 @@ def note_pressure(score: float, conf=None) -> None:
         mgr = _MANAGER
     if mgr is not None:
         mgr.note_pressure(score, conf)
+
+
+# Autoscaler scale-probe (ISSUE 20 brownout interplay): set by
+# parallel/cluster/autoscaler.Autoscaler while its loop is live.
+# Called with the pressure score at the moment sustained pressure
+# would flip brownout ON; returning True means a scale-up was accepted
+# (the fleet is below maxWorkers) and the brownout entry defers for
+# one more sustain window. None / False / raising = brownout proceeds.
+_SCALE_PROBE = None
+
+
+def register_scale_probe(probe) -> None:
+    """Install (or with ``None`` clear) the autoscaler's scale-up
+    probe consulted before brownout engages."""
+    global _SCALE_PROBE
+    _SCALE_PROBE = probe
 
 
 def backoff_ms(hint_ms: Optional[float], attempt: int, seed: int,

@@ -1,0 +1,469 @@
+"""SLO-driven autoscaling tests + the self-healing soak (ISSUE 20).
+
+Fast layers run under tier-1:
+
+- :func:`decide` policy arithmetic (scale-up triggers, cooldown,
+  scale-down idle clock, min/max bounds) — pure, no processes;
+- :class:`Autoscaler` acting through a fake-process supervisor;
+- brownout interplay: sustained pressure consults the scale probe and
+  DEFERS load shedding while the fleet has headroom.
+
+The slow-marked soak is the ISSUE 20 acceptance: 2000 mixed
+parameterized queries (SRT_SOAK=1; 120 in CI) x 4 tenants against an
+autoscaled pool, with a mid-soak SIGKILL storm of half the fleet and a
+seeded crash-looper. Every result bit-identical, healed deaths cost at
+most one stage recompute each, the crash-looper ends quarantined, and
+the fleet event log shows the worker count tracking load up AND down.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu import faults
+from spark_rapids_tpu.api.dataframe import TpuSession
+from spark_rapids_tpu.benchmarks import tpch
+from spark_rapids_tpu.monitoring import history
+from spark_rapids_tpu.parallel import cluster as CL
+from spark_rapids_tpu.parallel import scheduler as SC
+from spark_rapids_tpu.parallel.cluster.autoscaler import (
+    HOLD, SCALE_DOWN, SCALE_UP, Autoscaler, ScalerState, decide)
+from spark_rapids_tpu.parallel.cluster.supervisor import (
+    QUARANTINED, RUNNING, Supervisor)
+
+
+@pytest.fixture(autouse=True)
+def clean_cluster_state():
+    faults.configure("")
+    faults.reset_counters()
+    SC.reset_counters()
+    SC.register_scale_probe(None)
+    yield
+    CL.shutdown_coordinator()
+    SC.register_scale_probe(None)
+    faults.configure("")
+    faults.reset_counters()
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("tpch_autoscale"))
+    tpch.generate(d, scale=0.003, files_per_table=3, seed=7)
+    return d
+
+
+def _conf(**over):
+    s = TpuSession()
+    for k, v in over.items():
+        s.set(k, v)
+    return s.conf
+
+
+KNOBS = dict(min_workers=1, max_workers=4, target_queued_ms=500.0,
+             scale_up_step=1, scale_down_idle_s=10.0,
+             cooldown_ms=5000.0)
+
+
+# ---------------------------------------------------------------------------
+# decide(): pure policy
+# ---------------------------------------------------------------------------
+
+class TestDecidePolicy:
+    def test_scale_up_on_queued_ms_over_target(self):
+        d = decide(100.0, 2, {"queued_ms": 900.0}, ScalerState(),
+                   **KNOBS)
+        assert d["action"] == SCALE_UP and d["target"] == 3
+
+    def test_scale_up_when_queue_backed_up_and_all_busy(self):
+        # Queued work with every worker occupied is overload even when
+        # the wait quantile hasn't caught up yet.
+        d = decide(100.0, 2, {"queue_depth": 3, "busy": 2},
+                   ScalerState(), **KNOBS)
+        assert d["action"] == SCALE_UP and d["target"] == 3
+        # ...but a backed-up queue with idle workers is a dispatch gap,
+        # not missing capacity.
+        d = decide(100.0, 2, {"queue_depth": 3, "busy": 1},
+                   ScalerState(), **KNOBS)
+        assert d["action"] == HOLD
+
+    def test_scale_up_step_and_ceiling(self):
+        st = ScalerState()
+        d = decide(100.0, 2, {"queued_ms": 900.0}, st,
+                   **{**KNOBS, "scale_up_step": 3})
+        assert d["target"] == 4                  # capped at max
+        d = decide(100.0, 4, {"queued_ms": 900.0}, ScalerState(),
+                   **KNOBS)
+        assert d["action"] == HOLD and d["reason"] == "at-max-workers"
+
+    def test_cooldown_gates_consecutive_decisions(self):
+        st = ScalerState()
+        st.last_action_at = 99.0                 # acted 1s ago
+        d = decide(100.0, 2, {"queued_ms": 900.0}, st, **KNOBS)
+        assert d["action"] == HOLD and d["reason"] == "cooldown"
+        d = decide(105.0, 2, {"queued_ms": 900.0}, st, **KNOBS)
+        assert d["action"] == SCALE_UP           # cooldown expired
+
+    def test_scale_down_needs_sustained_idle_one_at_a_time(self):
+        st = ScalerState()
+        quiet = {"queued_ms": 10.0}
+        d = decide(100.0, 3, quiet, st, **KNOBS)
+        assert d["action"] == HOLD               # idle clock starts
+        d = decide(105.0, 3, quiet, st, **KNOBS)
+        assert d["action"] == HOLD               # 5s < scaleDownIdleS
+        d = decide(111.0, 3, quiet, st, **KNOBS)
+        assert d["action"] == SCALE_DOWN and d["target"] == 2
+
+    def test_overload_blip_resets_idle_clock_even_in_cooldown(self):
+        st = ScalerState()
+        st.under_target_since = 95.0
+        st.last_action_at = 99.9                 # cooling down
+        d = decide(100.0, 3, {"queued_ms": 900.0}, st, **KNOBS)
+        assert d["action"] == HOLD and d["reason"] == "cooldown"
+        assert st.under_target_since is None     # hysteresis held
+        d = decide(120.0, 3, {"queued_ms": 10.0}, st, **KNOBS)
+        assert d["action"] == HOLD               # clock restarted...
+        d = decide(131.0, 3, {"queued_ms": 10.0}, st, **KNOBS)
+        assert d["action"] == SCALE_DOWN         # ...and re-ran fully
+
+    def test_floor_min_workers(self):
+        st = ScalerState()
+        st.under_target_since = 0.0
+        d = decide(100.0, 1, {"queued_ms": 0.0}, st, **KNOBS)
+        assert d["action"] == HOLD and d["reason"] == "at-min-workers"
+
+    def test_pressure_score_alone_triggers_scale_up(self):
+        d = decide(100.0, 2, {"pressure": 1.2}, ScalerState(),
+                   **KNOBS)
+        assert d["action"] == SCALE_UP
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler acting through a fake-process supervisor
+# ---------------------------------------------------------------------------
+
+class FakeProc:
+    def __init__(self):
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.rc = -15
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+
+def _fake_pair(sig, **conf_over):
+    conf = _conf(**conf_over)
+    sup = Supervisor("127.0.0.1:1", conf=conf, prefix="t",
+                     spawn_fn=lambda wid, env: FakeProc(),
+                     stats_fn=lambda: {"workers": {}},
+                     verb_fn=lambda line: "OK")
+    scaler = Autoscaler(sup, conf=conf, signals_fn=lambda: sig)
+    return sup, scaler
+
+
+class TestAutoscalerLoop:
+    def test_scales_up_then_down_through_supervisor(self):
+        sig = {"queued_ms": 900.0, "queue_depth": 2, "busy": 1}
+        sup, scaler = _fake_pair(sig, **{
+            "spark.rapids.sql.cluster.autoscale.maxWorkers": 3,
+            "spark.rapids.sql.cluster.autoscale.cooldownMs": 0,
+            "spark.rapids.sql.cluster.autoscale.scaleDownIdleS": 1})
+        sup.add_worker()
+        d = scaler.tick(100.0)
+        assert d["action"] == SCALE_UP
+        assert sup.active_count() == 2
+        assert scaler.decisions["up"] == 1
+        sig.update(queued_ms=0.0, queue_depth=0, busy=0)
+        scaler.tick(200.0)                       # idle clock starts
+        d = scaler.tick(202.0)
+        assert d["action"] == SCALE_DOWN
+        # Scale-down DRAINS (never kills): the worker leaves the
+        # active set immediately and retires on clean exit.
+        assert sup.active_count() == 1
+        assert sup.counters["drains"] == 1
+        assert scaler.decisions["down"] == 1
+
+    def test_below_min_replenished_despite_cooldown(self):
+        sig = {"queued_ms": 0.0}
+        sup, scaler = _fake_pair(sig, **{
+            "spark.rapids.sql.cluster.autoscale.minWorkers": 2})
+        scaler.state.last_action_at = 99.9       # mid-cooldown
+        d = scaler.tick(100.0)
+        assert d["reason"] == "below-min-workers"
+        assert sup.active_count() == 2
+
+    def test_scale_probe_defers_below_max_declines_at_max(self):
+        sig = {"queued_ms": 0.0}
+        sup, scaler = _fake_pair(sig, **{
+            "spark.rapids.sql.cluster.autoscale.maxWorkers": 2})
+        sup.add_worker()
+        assert scaler.scale_probe(1.5) is True   # headroom: defer
+        assert sup.active_count() == 2           # and actually grew
+        assert scaler.scale_probe(1.5) is False  # at max: shed load
+
+
+class TestGatherSignals:
+    def test_sees_real_admission_queue_depth(self):
+        """Regression: queued_count is a PROPERTY — calling it like a
+        method raised TypeError inside gather_signals' guard and the
+        autoscaler was blind to queue depth (no scale-up ever fired in
+        the soak). The real signal path must see a blocked admit."""
+        conf = _conf(**{
+            "spark.rapids.sql.scheduler.maxConcurrentQueries": 1})
+        mgr = SC.get_query_manager(conf)._current()
+        sup = Supervisor("127.0.0.1:1", conf=_conf(), prefix="g",
+                         spawn_fn=lambda wid, env: FakeProc(),
+                         stats_fn=lambda: {"workers": {}},
+                         verb_fn=lambda line: "OK")
+        scaler = Autoscaler(sup, conf=_conf())   # real gather_signals
+        t1 = mgr.admit(conf)
+        blocked = threading.Thread(
+            target=lambda: mgr.finish(mgr.admit(conf)), daemon=True)
+        blocked.start()
+        try:
+            deadline = time.monotonic() + 5.0
+            depth = 0
+            while time.monotonic() < deadline:
+                depth = scaler.gather_signals()["queue_depth"]
+                if depth >= 1:
+                    break
+                time.sleep(0.01)
+            assert depth >= 1
+        finally:
+            mgr.finish(t1)
+            blocked.join(timeout=5.0)
+
+
+class TestBrownoutInterplay:
+    def test_sustained_pressure_defers_to_scaleup_then_engages(self):
+        """Capacity before degradation: with a live autoscaler below
+        maxWorkers, sustained pressure triggers scale-up and brownout
+        HOLDS OFF; once the probe declines (fleet at ceiling) the
+        brownout safety valve engages as before."""
+        conf = _conf(**{
+            "spark.rapids.sql.scheduler.pressure.enabled": True,
+            "spark.rapids.sql.scheduler.pressure.brownout.enterScore":
+                0.9,
+            "spark.rapids.sql.scheduler.pressure.brownout.sustainMs":
+                0})
+        mgr = SC.QueryManager(max_concurrent=2, queue_depth=4)
+        probed = []
+
+        def probe(score):
+            probed.append(score)
+            return True
+
+        SC.register_scale_probe(probe)
+        mgr.note_pressure(0.95, conf)
+        mgr.note_pressure(0.95, conf)
+        assert not mgr.brownout_active
+        assert len(probed) >= 1 and probed[0] == 0.95
+        assert SC.counters().get("brownoutDeferrals", 0) >= 1
+
+        SC.register_scale_probe(lambda score: False)   # fleet at max
+        mgr.note_pressure(0.95, conf)
+        assert mgr.brownout_active
+        assert SC.counters().get("brownouts", 0) == 1
+
+    def test_no_probe_means_unchanged_brownout_behavior(self):
+        conf = _conf(**{
+            "spark.rapids.sql.scheduler.pressure.enabled": True,
+            "spark.rapids.sql.scheduler.pressure.brownout.enterScore":
+                0.9,
+            "spark.rapids.sql.scheduler.pressure.brownout.sustainMs":
+                0})
+        mgr = SC.QueryManager(max_concurrent=2, queue_depth=4)
+        mgr.note_pressure(0.95, conf)
+        mgr.note_pressure(0.95, conf)
+        assert mgr.brownout_active               # pre-ISSUE-20 path
+        assert SC.counters().get("brownoutDeferrals", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# The acceptance soak (slow; 120 queries in CI, SRT_SOAK=1 runs 2000)
+# ---------------------------------------------------------------------------
+
+SEGMENTS = ["BUILDING", "AUTOMOBILE", "MACHINERY", "HOUSEHOLD"]
+N_SLOTS = 12
+
+
+def _shape_q3(s, data_dir, i):
+    """Parameterized q3: the two-join shipping-priority shape with the
+    date cut and market segment varying by slot — every query is
+    shuffle-forced (dispatchable stages) under
+    autoBroadcastJoinThreshold=-1."""
+    from spark_rapids_tpu.plan.logical import agg_sum, col, lit_col
+    slot = i % N_SLOTS
+    cut = tpch.days("1995-03-15") + (slot % 3) * 30 - 30
+    seg = SEGMENTS[slot % 4]
+    cust = tpch._read(s, data_dir, "customer") \
+        .filter(col("c_mktsegment") == lit_col(seg)) \
+        .select("c_custkey")
+    orders = tpch._read(s, data_dir, "orders") \
+        .filter(col("o_orderdate") < lit_col(cut)) \
+        .select("o_orderkey", "o_custkey", "o_orderdate",
+                "o_shippriority")
+    li = tpch._read(s, data_dir, "lineitem") \
+        .filter(col("l_shipdate") > lit_col(cut)) \
+        .select("l_orderkey", "l_extendedprice", "l_discount")
+    co = orders.join_on(cust, ["o_custkey"], ["c_custkey"])
+    j = li.join_on(co, ["l_orderkey"], ["o_orderkey"])
+    return j.group_by("l_orderkey", "o_orderdate", "o_shippriority") \
+        .agg(agg_sum(col("l_extendedprice")
+                     * (1.0 - col("l_discount"))).alias("revenue")) \
+        .order_by(col("revenue").desc(), col("o_orderdate").asc()) \
+        .limit(10)
+
+
+@pytest.mark.slow
+def test_autoscale_soak_self_healing(data_dir, tmp_path):
+    """ISSUE 20 acceptance: 2000 (CI: 120) mixed parameterized queries
+    x 4 tenants against an autoscaled pool. Mid-soak a SIGKILL storm
+    takes out half the fleet (healed: <= 1 stage recompute per death,
+    bit-identical results) and a seeded crash-looper burns through its
+    restart budget into quarantine. The fleet event log must show the
+    worker count tracking load: scale-ups while the clients hammer,
+    scale-downs once they stop."""
+    total = 2000 if os.environ.get("SRT_SOAK", "").strip() \
+        not in ("", "0") else 120
+    fleet_dir = str(tmp_path / "fleet")
+
+    def session():
+        s = TpuSession()
+        s.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+        s.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+        s.set("spark.rapids.sql.cluster.enabled", True)
+        s.set("spark.rapids.sql.cluster.heartbeatTimeoutMs", 1500)
+        s.set("spark.rapids.sql.eventLog.dir", fleet_dir)
+        return s
+
+    # Solo reference pass (local, no cluster) per parameter slot.
+    ref = TpuSession()
+    ref.set("spark.rapids.sql.variableFloatAgg.enabled", True)
+    ref.set("spark.rapids.sql.autoBroadcastJoinThreshold", -1)
+    expected = {slot: _shape_q3(ref, data_dir, slot).collect()
+                for slot in range(N_SLOTS)}
+
+    sessions = [session() for _ in range(4)]
+    co = CL.get_coordinator(sessions[0].conf)
+    addr = f"{co.addr[0]}:{co.addr[1]}"
+
+    aconf = _conf(**{
+        "spark.rapids.sql.cluster.autoscale.minWorkers": 1,
+        "spark.rapids.sql.cluster.autoscale.maxWorkers": 3,
+        "spark.rapids.sql.cluster.autoscale.targetQueuedMs": 50,
+        "spark.rapids.sql.cluster.autoscale.scaleDownIdleS": 2,
+        "spark.rapids.sql.cluster.autoscale.cooldownMs": 1000,
+        "spark.rapids.sql.cluster.supervisor.pollMs": 100,
+        "spark.rapids.sql.cluster.supervisor.restartBackoffBaseMs":
+            100,
+        "spark.rapids.sql.cluster.supervisor.crashLoopThreshold": 3,
+    })
+    sup = Supervisor(addr, conf=aconf, prefix="a", heartbeat_ms=500)
+    scaler = Autoscaler(sup, conf=aconf)
+    sup.add_worker()
+    # The seeded crash-looper: SIGKILLs itself on its first stage of
+    # every life; the preserved env makes every restart die the same
+    # way until quarantine.
+    sup.add_worker(wid="looper", extra_env={
+        "SRT_FAULTS": "workerdeath@cluster.stage:1",
+        "SRT_FAULTS_SEED": "7"})
+
+    c0 = dict(faults.counters())
+    lock = threading.Lock()
+    done = [0]
+    failures = []
+    per_client = total // len(sessions)
+    storm_at = per_client // 2
+    storm_fired = threading.Event()
+
+    def storm():
+        """SIGKILL half the running fleet, supervisor heals it."""
+        with sup._lock:
+            running = [w for w in sup.workers.values()
+                       if w.state == RUNNING and w.wid != "looper"
+                       and w.proc.poll() is None]
+        victims = running[:max(len(running) // 2, 1)]
+        for w in victims:
+            w.proc.kill()
+        return [w.wid for w in victims]
+
+    def client(k):
+        s = sessions[k]
+        for j in range(per_client):
+            i = k * per_client + j
+            if k == 0 and j == storm_at and not storm_fired.is_set():
+                storm_fired.set()
+                storm()
+            df = _shape_q3(s, data_dir, i)
+            try:
+                rows = SC.collect_with_retry(df.collect, conf=s.conf,
+                                             seed=k)
+            except BaseException as e:  # pragma: no cover
+                with lock:
+                    failures.append((k, i, repr(e)))
+                return
+            with lock:
+                done[0] += 1
+                if rows != expected[i % N_SLOTS]:
+                    failures.append((k, i, "diverged from solo run"))
+
+    sup.start()
+    scaler.start()
+    try:
+        threads = [threading.Thread(target=client, args=(k,),
+                                    name=f"autoscale-soak-{k}")
+                   for k in range(len(sessions))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(1800)
+        assert failures == [], failures[:10]
+        assert done[0] == total
+        assert storm_fired.is_set()
+
+        # Quiet period: the idle clock runs down and the fleet shrinks.
+        deadline = time.monotonic() + 30
+        while sup.active_count() > scaler.min_workers and \
+                time.monotonic() < deadline:
+            time.sleep(0.25)
+
+        c1 = faults.counters()
+        delta = lambda k: c1.get(k, 0) - c0.get(k, 0)
+        # Self-healing invariant: workers run ONE stage at a time, so
+        # every death (storm + crash-looper) costs AT MOST one stage
+        # recompute; drains cost zero.
+        assert delta("clusterWorkerDeaths") >= 1          # storm hit
+        assert delta("stageRecomputes") <= \
+            delta("clusterWorkerDeaths")
+        # The storm actually healed: restarts happened and the pool
+        # ended the soak serving from supervised workers.
+        assert sup.counters["restarts"] >= 1
+        # The crash-looper burned its budget into quarantine.
+        assert "looper" in sup.quarantined()
+        assert "crash-loop" in sup.quarantined()["looper"]
+        assert sup.counters["quarantines"] == 1
+        # The autoscaler visibly tracked load in the fleet event log:
+        # scale-ups under the client hammer, scale-downs after.
+        events = history.read_fleet_events(fleet_dir)
+        kinds = [e["event"] for e in events]
+        assert "autoscale-up" in kinds
+        assert "autoscale-down" in kinds
+        peak = max(e["workers"] for e in events)
+        assert peak >= 2                       # it actually grew
+        assert sup.active_count() <= peak      # ...and shrank back
+        # Scale-downs drained cleanly: every retirement committed its
+        # manifests first, so drains never show up as recomputes.
+        assert sup.counters["drains"] >= 1
+    finally:
+        scaler.stop()
+        sup.close()

@@ -490,6 +490,53 @@ def test_hint_on_fifo_queue_full_and_timeout():
     mgr2.finish(hog2)
 
 
+def test_hint_on_dispatch_timeout_backs_off_in_collect_with_retry():
+    """Cluster dispatch-timeout rejections (UNAVAILABLE from the
+    coordinator barrier) are typed QueryRejectedError subclasses
+    carrying retry_after_ms, so collect_with_retry treats a congested
+    fleet like any other load rejection: back off and resubmit instead
+    of re-raising (ISSUE 20 satellite)."""
+    from spark_rapids_tpu.parallel.cluster.coordinator import (
+        ClusterDispatchError, dispatch_timeout_error)
+    err = dispatch_timeout_error(
+        "UNAVAILABLE: cluster dispatch of query 1 incomplete after "
+        "50ms (0/4 committed)", queue_depth=4, retry_after_ms=40.0)
+    assert isinstance(err, QueryRejectedError)
+    assert err.kind == "dispatch-timeout"
+    assert err.retry_after_ms == 40.0 and err.queue_depth == 4
+    # The message keeps the UNAVAILABLE shape the recovery ladder
+    # classifies as transient — subclassing must not change it.
+    assert oom.is_transient_error(err)
+
+    calls, sleeps = [], []
+
+    def attempt():
+        calls.append(1)
+        if len(calls) < 3:
+            raise dispatch_timeout_error(
+                "UNAVAILABLE: dispatch incomplete", retry_after_ms=40.0)
+        return "ok"
+
+    c0 = SC.counters().get("clientRetries", 0)
+    assert SC.collect_with_retry(attempt, max_attempts=5,
+                                 sleep=sleeps.append) == "ok"
+    assert len(calls) == 3
+    assert len(sleeps) == 2 and all(s > 0 for s in sleeps)
+    assert SC.counters().get("clientRetries", 0) - c0 == 2
+    assert SC.counters().get("clientRetries.dispatch-timeout", 0) >= 2
+
+    # Hintless cluster errors (budget exhaustion, poisoned plans) are
+    # NOT retryable-by-wait: re-raise immediately, zero sleeps.
+    def hopeless():
+        raise ClusterDispatchError("stage s3 failed after max retries")
+
+    sleeps2 = []
+    with pytest.raises(ClusterDispatchError):
+        SC.collect_with_retry(hopeless, max_attempts=5,
+                              sleep=sleeps2.append)
+    assert sleeps2 == []
+
+
 # ---------------------------------------------------------------------------
 # Resize-at-idle must not drop queued tickets (ISSUE 18 satellite)
 # ---------------------------------------------------------------------------
